@@ -1,0 +1,25 @@
+"""ape_x_dqn_tpu — a TPU-native Ape-X DQN framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of the reference
+``lefarov/Ape-X-DQN`` (Distributed Prioritized Experience Replay, Horgan et
+al. 2018): ε-ladder actor fleets, n-step double-Q learning, central
+prioritized replay with a sum-tree, async actor∥replay∥learner pipeline, and
+a data-parallel pjit learner over a TPU mesh.
+"""
+
+from ape_x_dqn_tpu.types import (
+    NStepTransition,
+    PrioritizedBatch,
+    TrainState,
+    Transition,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "NStepTransition",
+    "PrioritizedBatch",
+    "TrainState",
+    "Transition",
+    "__version__",
+]

@@ -1,0 +1,150 @@
+"""The fused learner step — one XLA program per gradient update.
+
+This is the north-star fusion (BASELINE.json): everything the reference
+learner does per update across four call sites and three host↔host RPCs
+(reference learner.py:63-80 — sample unpack, double-Q target, TD error, loss,
+RMSProp step, target-net sync, priority computation) compiles into a single
+jitted function:
+
+    train_step(state, batch) -> (new_state, StepMetrics)
+
+Semantics implemented are the *intended* ones (SURVEY §2.8 defect register):
+  * target net copies every ``target_sync_freq`` steps (the reference's modulo
+    gate is inverted — learner.py:60);
+  * per-transition priorities (the reference collapses them — learner.py:50);
+  * terminal masking via the n-step discount (the reference bootstraps through
+    episode ends);
+  * RMSProp decay is decay, not L2 weight-decay (learner.py:26 misroutes it).
+
+The returned function is pure and donation-friendly: ``state`` is donated so
+params/opt-state update in place in HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax import struct
+
+from ape_x_dqn_tpu.ops import losses
+from ape_x_dqn_tpu.types import PrioritizedBatch, TrainState
+
+
+@struct.dataclass
+class StepMetrics:
+    loss: jax.Array            # float32 []
+    mean_abs_td: jax.Array     # float32 []
+    max_abs_td: jax.Array      # float32 []
+    priorities: jax.Array      # float32 [B] — new replay priorities
+    mean_q: jax.Array          # float32 []
+
+
+def make_optimizer(
+    kind: str = "rmsprop",
+    learning_rate: float = 0.00025 / 4,
+    rmsprop_decay: float = 0.95,
+    rmsprop_eps: float = 1.5e-7,
+    adam_b1: float = 0.9,
+    adam_b2: float = 0.999,
+    max_grad_norm: float | None = 40.0,
+) -> optax.GradientTransformation:
+    """Reference-parity RMSProp (lr 0.00025/4, eps 1.5e-7 — learner.py:26,
+    with decay routed correctly) or Adam, with optional grad clipping."""
+    if kind == "rmsprop":
+        opt = optax.rmsprop(learning_rate, decay=rmsprop_decay, eps=rmsprop_eps)
+    elif kind == "adam":
+        opt = optax.adam(learning_rate, b1=adam_b1, b2=adam_b2)
+    else:
+        raise ValueError(f"unknown optimizer kind: {kind}")
+    if max_grad_norm is not None:
+        opt = optax.chain(optax.clip_by_global_norm(max_grad_norm), opt)
+    return opt
+
+
+def init_train_state(
+    network: nn.Module,
+    optimizer: optax.GradientTransformation,
+    rng: jax.Array,
+    sample_obs: jax.Array,
+) -> TrainState:
+    """Initialize params/target/opt-state from one example observation batch."""
+    params = network.init(rng, sample_obs)
+    return TrainState(
+        params=params,
+        target_params=jax.tree_util.tree_map(jnp.copy, params),
+        opt_state=optimizer.init(params),
+        step=jnp.zeros((), jnp.int32),
+        rng=rng,
+    )
+
+
+def build_train_step(
+    network: nn.Module,
+    optimizer: optax.GradientTransformation,
+    loss_kind: str = "huber",
+    huber_kappa: float = 1.0,
+    target_sync_freq: int = 2500,
+    use_is_weights: bool = True,
+    priority_epsilon: float = 1e-6,
+    jit: bool = True,
+) -> Callable[[TrainState, PrioritizedBatch], Tuple[TrainState, StepMetrics]]:
+    """Build the fused step.  All knobs are static — baked into the XLA program."""
+
+    def loss_fn(params, target_params, batch: PrioritizedBatch):
+        t = batch.transition
+        B = t.action.shape[0]
+        # One online forward over [obs; next_obs] (2B) instead of two B-sized
+        # passes — bigger matmuls tile better on the MXU.
+        q_both = network.apply(params, jnp.concatenate([t.obs, t.next_obs], axis=0))[2]
+        q_values, q_next_online = q_both[:B], q_both[B:]
+        q_next_target = network.apply(target_params, t.next_obs)[2]
+        targets = losses.double_q_target(
+            q_next_online, q_next_target, t.reward, t.discount
+        )
+        delta = losses.td_error(q_values, t.action, targets)
+        weights = batch.is_weights if use_is_weights else None
+        loss = losses.td_loss(delta, weights, kind=loss_kind, huber_kappa=huber_kappa)
+        return loss, (delta, q_values)
+
+    def train_step(state: TrainState, batch: PrioritizedBatch):
+        (loss, (delta, q_values)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, state.target_params, batch)
+        # When the batch is sharded over a data axis under pjit/shard_map, the
+        # mean inside loss_fn makes XLA insert the gradient all-reduce over
+        # ICI automatically — no explicit collective needed here.
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        step = state.step + 1
+        # Intended target sync: copy exactly every target_sync_freq steps
+        # (reference learner.py:60 inverts this gate).
+        sync = (step % target_sync_freq) == 0
+        new_target = jax.tree_util.tree_map(
+            lambda online, target: jnp.where(sync, online, target),
+            new_params,
+            state.target_params,
+        )
+        metrics = StepMetrics(
+            loss=loss,
+            mean_abs_td=jnp.mean(jnp.abs(delta)),
+            max_abs_td=jnp.max(jnp.abs(delta)),
+            priorities=losses.priorities_from_td(delta, priority_epsilon),
+            mean_q=jnp.mean(q_values),
+        )
+        new_state = TrainState(
+            params=new_params,
+            target_params=new_target,
+            opt_state=new_opt_state,
+            step=step,
+            rng=state.rng,
+        )
+        return new_state, metrics
+
+    if jit:
+        return jax.jit(train_step, donate_argnums=(0,))
+    return train_step

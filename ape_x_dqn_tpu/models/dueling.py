@@ -1,0 +1,136 @@
+"""Dueling Q-networks as Flax modules.
+
+Capability parity with reference duelling_network.py:3-28 (the 28-line torch
+module), TPU-first:
+  * Conv torso Conv(8×8/4) → Conv(4×4/2) → Conv(3×3/1) → flatten → two
+    512-unit streams → value head (1) + advantage head (A).  Default channel
+    widths 64/64/64 match the reference (NOT the Nature-DQN 32/64/64 —
+    SURVEY §2 component 5); ``channels=(32, 64, 64)`` gives the Nature stack.
+  * Aggregation is the *intended* per-row mean:  Q = V + (A − mean_a A)
+    (the reference's ``advantage.sum()`` reduces over the whole batch —
+    duelling_network.py:27, defect register SURVEY §2.8).
+  * ``forward`` returns ``(value, advantage, q)`` matching the reference's
+    triple return (duelling_network.py:28); callers that only need Q use
+    ``.q_values()``.
+  * Compute dtype is configurable (bfloat16 by default on TPU — MXU-native);
+    params stay float32.  uint8 inputs are normalized inside the module so
+    frames travel HBM as bytes.
+  * NHWC layout (TPU conv-friendly), vs the reference's NCHW.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class DuelingOutput(NamedTuple):
+    """(value, advantage, q) — index [2] for Q, as reference callers do.
+
+    A NamedTuple so it is a registered JAX pytree: network outputs can cross
+    jit/vmap/scan boundaries intact (e.g. returned from a jitted rollout).
+    """
+
+    value: jax.Array
+    advantage: jax.Array
+    q: jax.Array
+
+
+def _dueling_aggregate(value: jax.Array, advantage: jax.Array) -> jax.Array:
+    return value + advantage - jnp.mean(advantage, axis=-1, keepdims=True)
+
+
+class DuelingDQN(nn.Module):
+    """Convolutional dueling Q-network for image observations.
+
+    Attributes:
+      num_actions: size of the action space.
+      channels: conv channel widths (reference parity default (64, 64, 64)).
+      hidden: width of each dueling stream's hidden layer (reference: 512).
+      compute_dtype: activation dtype — bfloat16 rides the MXU natively.
+    """
+
+    num_actions: int
+    channels: Sequence[int] = (64, 64, 64)
+    hidden: int = 512
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        # Accept NHWC uint8 or float; [B, H, W, C].  Guard against the
+        # reference's NCHW layout, which otherwise fails deep inside flax.
+        if x.ndim != 4:
+            raise ValueError(f"expected NHWC [B, H, W, C] observations, got shape {x.shape}")
+        if x.shape[1] <= 4 and x.shape[3] > 4:
+            # Frame stacks have <=4 channels; an axis-1 extent that small with a
+            # large trailing axis is almost certainly channels-first input.
+            raise ValueError(
+                f"observations look NCHW (shape {x.shape}); this framework uses "
+                "NHWC [B, H, W, C] — transpose with x.transpose(0, 2, 3, 1)"
+            )
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.compute_dtype) / 255.0
+        else:
+            x = x.astype(self.compute_dtype)
+        kernels = ((8, 8), (4, 4), (3, 3))
+        strides = ((4, 4), (2, 2), (1, 1))
+        if len(self.channels) != len(kernels):
+            raise ValueError(
+                f"channels must have exactly {len(kernels)} entries, got {self.channels}"
+            )
+        for ch, k, s in zip(self.channels, kernels, strides):
+            x = nn.Conv(ch, k, s, padding="VALID", dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        v = nn.relu(nn.Dense(self.hidden, dtype=self.compute_dtype)(x))
+        a = nn.relu(nn.Dense(self.hidden, dtype=self.compute_dtype)(x))
+        value = nn.Dense(1, dtype=jnp.float32)(v)
+        advantage = nn.Dense(self.num_actions, dtype=jnp.float32)(a)
+        value = value.astype(jnp.float32)
+        advantage = advantage.astype(jnp.float32)
+        q = _dueling_aggregate(value, advantage)
+        return DuelingOutput(value, advantage, q)
+
+    def q_values(self, x: jax.Array) -> jax.Array:
+        return self(x)[2]
+
+
+class DuelingMLP(nn.Module):
+    """Dueling Q-network for flat/vector observations (small envs, unit tests,
+    chain-MDP learning tests — SURVEY §4 level 3)."""
+
+    num_actions: int
+    hidden_sizes: Sequence[int] = (256, 256)
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.compute_dtype) / 255.0
+        else:
+            x = x.astype(self.compute_dtype)
+        x = x.reshape((x.shape[0], -1))
+        for h in self.hidden_sizes:
+            x = nn.relu(nn.Dense(h, dtype=self.compute_dtype)(x))
+        value = nn.Dense(1, dtype=jnp.float32)(x)
+        advantage = nn.Dense(self.num_actions, dtype=jnp.float32)(x)
+        q = _dueling_aggregate(value.astype(jnp.float32), advantage.astype(jnp.float32))
+        return DuelingOutput(value, advantage, q)
+
+    def q_values(self, x: jax.Array) -> jax.Array:
+        return self(x)[2]
+
+
+def build_network(kind: str, num_actions: int, **kwargs) -> nn.Module:
+    """Factory keyed by config string: {"conv", "nature", "mlp"}."""
+    if kind == "conv":
+        return DuelingDQN(num_actions=num_actions, **kwargs)
+    if kind == "nature":
+        kwargs.setdefault("channels", (32, 64, 64))
+        return DuelingDQN(num_actions=num_actions, **kwargs)
+    if kind == "mlp":
+        return DuelingMLP(num_actions=num_actions, **kwargs)
+    raise ValueError(f"unknown network kind: {kind}")

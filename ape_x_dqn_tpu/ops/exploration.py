@@ -1,0 +1,47 @@
+"""Exploration: the Ape-X per-actor ε-ladder and ε-greedy action selection.
+
+The ladder follows reference actor.py:111-114: actor i of N uses
+    ε_i = ε^(1 + α·i/(N−1))          (ε=0.4, α=7 — parameters.json:12-13)
+which is the Ape-X paper's schedule.  For N == 1 the exponent is 1 (the
+reference would divide by zero; we define the single-actor case as ε itself).
+
+Action selection is fully vectorized so a fleet of actors can pick actions in
+one fused op on device (batch of q-value rows + batch of ε's).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def epsilon_ladder(base_epsilon: float, alpha: float, num_actors: int) -> jnp.ndarray:
+    """float32 [num_actors] of per-actor ε values."""
+    if num_actors <= 0:
+        raise ValueError("num_actors must be positive")
+    if num_actors == 1:
+        return jnp.asarray([base_epsilon], jnp.float32)
+    i = jnp.arange(num_actors, dtype=jnp.float32)
+    exponent = 1.0 + alpha * i / (num_actors - 1)
+    return jnp.power(base_epsilon, exponent).astype(jnp.float32)
+
+
+def epsilon_greedy(
+    rng: jax.Array, q_values: jax.Array, epsilon: jax.Array
+) -> jax.Array:
+    """Batched ε-greedy (reference actor.py:121-125, vectorized).
+
+    Args:
+      rng: PRNGKey.
+      q_values: float [B, A].
+      epsilon: float [] or [B].
+
+    Returns:
+      int32 [B] actions.
+    """
+    B, A = q_values.shape
+    explore_rng, action_rng = jax.random.split(rng)
+    greedy = jnp.argmax(q_values, axis=-1).astype(jnp.int32)
+    random_actions = jax.random.randint(action_rng, (B,), 0, A, dtype=jnp.int32)
+    explore = jax.random.uniform(explore_rng, (B,)) < epsilon
+    return jnp.where(explore, random_actions, greedy)

@@ -1,0 +1,94 @@
+"""Double-Q targets, TD errors, losses and priorities — pure functions.
+
+Implements the intended semantics of reference learner.py:29-52:
+  * n-step double-Q target  G_t = R_{t→t+n} + D_n · Q_target(S', argmax_a Q(S',a))
+    (reference learner.py:43-45), with the terminal mask folded into D_n
+    (the reference has no done-mask — SURVEY §2.8).
+  * TD error δ = Q(S_t, A_t) − G_t and loss = mean(w · ℓ(δ)) where ℓ is
+    ½δ² for parity with the reference (learner.py:47-48) or Huber (the
+    north-star option), and w are importance-sampling weights (the
+    reference's README-TODO, config key parameters.json:30 read by nothing).
+  * Per-transition priorities |δ| (the reference collapses them to one value
+    via a dict-comprehension bug — learner.py:50, SURVEY §2.8).
+
+Everything here is shape-polymorphic, jit-friendly, and differentiable only
+through the online-net Q values (targets are lax.stop_gradient'ed).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def double_q_target(
+    q_online_next: jax.Array,
+    q_target_next: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+) -> jax.Array:
+    """n-step double-Q bootstrap target.
+
+    Args:
+      q_online_next: float [B, A] — online net at S_{t+n} (action selection).
+      q_target_next: float [B, A] — target net at S_{t+n} (action evaluation).
+      rewards: float [B] — accumulated n-step returns R_{t→t+n}.
+      discounts: float [B] — bootstrap discount γ^n·(terminal mask).
+
+    Returns:
+      float [B] targets, stop-gradient'ed.
+    """
+    best_actions = jnp.argmax(q_online_next, axis=-1)
+    bootstrap = jnp.take_along_axis(
+        q_target_next, best_actions[:, None], axis=-1
+    )[:, 0]
+    return jax.lax.stop_gradient(rewards + discounts * bootstrap)
+
+
+def max_q_target(
+    q_next: jax.Array, rewards: jax.Array, discounts: jax.Array
+) -> jax.Array:
+    """Plain max-Q bootstrap — the actor-side initial-priority rule
+    (reference actor.py:138-142 uses max-Q, not double-Q)."""
+    return jax.lax.stop_gradient(rewards + discounts * jnp.max(q_next, axis=-1))
+
+
+def td_error(q_values: jax.Array, actions: jax.Array, targets: jax.Array) -> jax.Array:
+    """δ = Q(S_t, A_t) − G_t, float [B]."""
+    chosen = jnp.take_along_axis(q_values, actions[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return chosen - targets
+
+
+def huber(delta: jax.Array, kappa: float = 1.0) -> jax.Array:
+    """Per-element Huber loss ℓ_κ(δ)."""
+    abs_d = jnp.abs(delta)
+    quad = jnp.minimum(abs_d, kappa)
+    return 0.5 * quad**2 + kappa * (abs_d - quad)
+
+
+def squared(delta: jax.Array) -> jax.Array:
+    """Parity loss: ½δ² (reference learner.py:48 — squared, not Huber)."""
+    return 0.5 * delta**2
+
+
+def td_loss(
+    delta: jax.Array,
+    is_weights: jax.Array | None = None,
+    kind: str = "huber",
+    huber_kappa: float = 1.0,
+) -> jax.Array:
+    """Weighted mean TD loss. ``kind`` in {"huber", "squared"} (static)."""
+    if kind == "huber":
+        per = huber(delta, huber_kappa)
+    elif kind == "squared":
+        per = squared(delta)
+    else:
+        raise ValueError(f"unknown loss kind: {kind}")
+    if is_weights is not None:
+        per = per * is_weights
+    return jnp.mean(per)
+
+
+def priorities_from_td(delta: jax.Array, epsilon: float = 1e-6) -> jax.Array:
+    """Replay priorities p = |δ| + ε, per transition (not collapsed)."""
+    return jnp.abs(delta) + epsilon

@@ -1,0 +1,112 @@
+"""Vectorized n-step return construction.
+
+The reference accumulates n-step returns with an O(n²)-per-step Python loop
+inside ``ExperienceBuffer.update_buffer`` (reference: actor.py:29-43) and emits
+**non-overlapping** windows (the window advances n steps per emitted
+transition).  It also stores a wrong bootstrap discount (γ^(n−1) instead of
+γ^n) and bootstraps through terminals (SURVEY §2.8).
+
+Here the same math is a single ``lax.scan``-free vectorized computation over a
+rollout segment — O(T·n) fused element-wise work that XLA vectorizes, usable
+both on device (inside a jitted actor rollout) and on host via numpy semantics.
+
+Definitions, for per-step reward r_t and per-step discount d_t = γ·(1−done_t):
+
+    R^{(n)}_t = Σ_{k=0}^{n-1} (Π_{j<k} d_{t+j}) · r_{t+k}
+    D^{(n)}_t = Π_{j=0}^{n-1} d_{t+j}              (0 if any step terminated)
+    S'_t      = obs_{t+n}
+
+so the learner target is exactly ``R + D · Q_target(S')`` with no done-mask
+special case (the mask is folded into D).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ape_x_dqn_tpu.types import NStepTransition
+
+
+def nstep_returns(rewards: jax.Array, discounts: jax.Array, n: int):
+    """Compute n-step returns and bootstrap discounts for every start index.
+
+    Args:
+      rewards: float32 [T] — reward received after step t.
+      discounts: float32 [T] — γ·(1−done_t) for step t.
+      n: the n-step horizon (static).
+
+    Returns:
+      (returns, boot_discounts): each float32 [T - n + 1]; entry t covers the
+      window [t, t+n).
+    """
+    T = rewards.shape[0]
+    if T < n:
+        raise ValueError(f"rollout length {T} < n-step horizon {n}")
+    out_len = T - n + 1
+    # returns_k / disc_k built iteratively over the (static, small) horizon:
+    #   acc_{k+1} = acc_k + cumdisc_k * r_{t+k};  cumdisc_{k+1} = cumdisc_k * d_{t+k}
+    acc = jnp.zeros((out_len,), jnp.float32)
+    cumdisc = jnp.ones((out_len,), jnp.float32)
+    for k in range(n):
+        r_k = jax.lax.dynamic_slice_in_dim(rewards, k, out_len)
+        d_k = jax.lax.dynamic_slice_in_dim(discounts, k, out_len)
+        acc = acc + cumdisc * r_k
+        cumdisc = cumdisc * d_k
+    return acc, cumdisc
+
+
+def build_nstep_transitions(
+    obs: jax.Array,
+    actions: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    bootstrap_obs: jax.Array,
+    n: int,
+    stride: int = 1,
+) -> NStepTransition:
+    """Build n-step transitions from a rollout segment.
+
+    Args:
+      obs: uint8 [T, *obs_shape] — observations S_0..S_{T-1}.
+      actions: int32 [T].
+      rewards: float32 [T].
+      discounts: float32 [T] — γ·(1−done_t).
+      bootstrap_obs: uint8 [n, *obs_shape] — the ``n`` observations
+        immediately after the segment (S_T .. S_{T+n-1}); S_{t+n} per start
+        index is then sliced from ``concat([obs, bootstrap_obs])``.  At
+        episode boundaries the bootstrap obs content is irrelevant because
+        the bootstrap discount is 0.
+      n: horizon.
+      stride: 1 for overlapping windows (standard Ape-X), ``n`` for the
+        reference's non-overlapping emission (reference actor.py:44-70).
+
+    Returns:
+      NStepTransition with batch dim ceil((T-n+1)/stride).
+    """
+    returns, boot = nstep_returns(rewards, discounts, n)
+    all_obs = jnp.concatenate([obs, bootstrap_obs], axis=0)
+    out_len = returns.shape[0]
+    starts = jnp.arange(0, out_len, stride)
+    next_obs = all_obs[starts + n]
+    return NStepTransition(
+        obs=obs[starts],
+        action=actions[starts],
+        reward=returns[starts],
+        discount=boot[starts],
+        next_obs=next_obs,
+    )
+
+
+def nstep_returns_reference(rewards, discounts, n):
+    """Slow pure-Python oracle for tests (mirrors the paper definition)."""
+    T = len(rewards)
+    outs, boots = [], []
+    for t in range(T - n + 1):
+        acc, cd = 0.0, 1.0
+        for k in range(n):
+            acc += cd * float(rewards[t + k])
+            cd *= float(discounts[t + k])
+        outs.append(acc)
+        boots.append(cd)
+    return outs, boots

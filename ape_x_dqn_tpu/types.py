@@ -1,0 +1,108 @@
+"""Transition schema and train-state pytrees.
+
+The reference duplicates two namedtuples (``Transition``/``N_Step_Transition``)
+by copy-paste across three files (reference: actor.py:11-12, learner.py:8,
+replay.py:5).  Here the wire format is a single set of ``flax.struct`` pytrees
+shared by every subsystem, so they move through ``jit``/``pjit`` and across
+host threads without conversion.
+
+Design notes (TPU-first):
+  * Observations are stored ``uint8`` end-to-end and cast to compute dtype
+    only inside the jitted step — HBM bandwidth and replay RAM are the
+    bottleneck, not FLOPs.
+  * Replay identity is an integer slot index, not the reference's string key
+    ``str(actor_id)+str(seq_num)`` (reference: actor.py:47) — string keys force
+    O(N) scans (reference: replay.py:54-56); indices make priority updates
+    O(log N) in the sum-tree.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+Array = jax.Array
+PyTree = Any
+
+
+@struct.dataclass
+class Transition:
+    """One environment step (the reference's 1-step ``Transition``).
+
+    Fields mirror reference actor.py:11 ``Transition(S, A, R, Gamma, q)``,
+    with the q-values kept for actor-side priority computation and an explicit
+    terminal flag the reference lacks (defect register SURVEY §2.8: the
+    reference bootstraps through episode ends).
+    """
+
+    obs: Array          # uint8 [*obs_shape]
+    action: Array       # int32 []
+    reward: Array       # float32 []
+    discount: Array     # float32 [] — gamma * (1 - terminal)
+    q_values: Array     # float32 [num_actions] — online-net values at obs
+
+
+@struct.dataclass
+class NStepTransition:
+    """An n-step transition (reference actor.py:12 ``N_Step_Transition``).
+
+    ``reward`` is the accumulated n-step return R_{t→t+n}; ``discount`` is the
+    *correct* bootstrap factor γ^n with terminal masking (the reference stores
+    γ^(n−1) and never masks — SURVEY §2.8), so the learner target is simply
+    ``reward + discount * bootstrap`` with no special cases.
+    """
+
+    obs: Array          # uint8 [*obs_shape]        — S_t
+    action: Array       # int32 []                  — A_t
+    reward: Array       # float32 []                — R_{t→t+n}
+    discount: Array     # float32 []                — prod_k γ·(1−done_k), 0 past terminal
+    next_obs: Array     # uint8 [*obs_shape]        — S_{t+n}
+
+    @property
+    def batch_shape(self):
+        return self.action.shape
+
+
+@struct.dataclass
+class PrioritizedBatch:
+    """A replay sample as fed to the learner: transitions + sampling metadata."""
+
+    transition: NStepTransition
+    indices: Array      # int32 [B] — replay slot ids, echoed back for priority update
+    is_weights: Array   # float32 [B] — importance-sampling weights (β-annealed)
+
+
+@struct.dataclass
+class TrainState:
+    """Full learner state: one pytree, one checkpoint, one donation unit.
+
+    Covers everything the reference fails to checkpoint (reference
+    learner.py:18-23 restores only the online net): params, target params,
+    optimizer state, step counter and PRNG key.
+    """
+
+    params: PyTree
+    target_params: PyTree
+    opt_state: PyTree
+    step: Array         # int32 []
+    rng: Array          # PRNGKey
+
+
+def host_stack(transitions):
+    """Stack a list of same-structure pytrees into one batched pytree (numpy).
+
+    Host-side helper for the actor→replay path; stays off the device.
+    """
+    leaves = [jax.tree_util.tree_leaves(t) for t in transitions]
+    treedef = jax.tree_util.tree_structure(transitions[0])
+    stacked = [np.stack([l[i] for l in leaves]) for i in range(len(leaves[0]))]
+    return jax.tree_util.tree_unflatten(treedef, stacked)
+
+
+def tree_slice(tree: PyTree, idx) -> PyTree:
+    """Index every leaf of a batched pytree (host or device)."""
+    return jax.tree_util.tree_map(lambda x: x[idx], tree)

@@ -1,0 +1,31 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+This is the TPU analogue of "test multi-node without a real cluster"
+(SURVEY §4): pjit/shard_map sharding and collectives run on 8 fake host
+devices, so every distributed-semantics test runs anywhere.
+Must run before jax initializes its backends, hence env vars at import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the outer env points at the TPU tunnel
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The container's sitecustomize registers the axon TPU plugin at interpreter
+# start and pins jax_platforms=axon, so the env var alone is not enough —
+# override via jax.config before any backend initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

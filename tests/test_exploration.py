@@ -1,0 +1,44 @@
+"""ε-ladder values and ε-greedy behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ape_x_dqn_tpu.ops.exploration import epsilon_greedy, epsilon_ladder
+
+
+def test_ladder_matches_apex_formula():
+    # eps_i = eps^(1 + alpha*i/(N-1)), eps=0.4, alpha=7 (reference actor.py:114)
+    eps, alpha, N = 0.4, 7.0, 5
+    got = np.asarray(epsilon_ladder(eps, alpha, N))
+    expected = [eps ** (1 + alpha * i / (N - 1)) for i in range(N)]
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+    assert got[0] == np.float32(0.4)
+    assert np.all(np.diff(got) < 0)  # monotonically more greedy
+
+
+def test_ladder_single_actor():
+    np.testing.assert_allclose(np.asarray(epsilon_ladder(0.4, 7.0, 1)), [0.4])
+
+
+def test_epsilon_zero_is_greedy():
+    q = jnp.asarray([[0.0, 1.0], [5.0, -1.0]])
+    a = epsilon_greedy(jax.random.PRNGKey(0), q, jnp.zeros(2))
+    np.testing.assert_array_equal(np.asarray(a), [1, 0])
+
+
+def test_epsilon_one_is_uniform():
+    q = jnp.tile(jnp.asarray([[0.0, 10.0, 0.0, 0.0]]), (4000, 1))
+    a = epsilon_greedy(jax.random.PRNGKey(1), q, jnp.ones(4000))
+    counts = np.bincount(np.asarray(a), minlength=4)
+    assert (counts > 800).all()  # roughly uniform over 4 actions
+
+
+def test_per_actor_epsilon_broadcast():
+    # actor 0 epsilon=1 (random), actor 1 epsilon=0 (greedy)
+    q = jnp.tile(jnp.asarray([[0.0, 10.0]]), (2000, 1))
+    eps = jnp.asarray([1.0, 0.0] * 1000)
+    a = np.asarray(epsilon_greedy(jax.random.PRNGKey(2), q, eps))
+    greedy_slots = a[1::2]
+    np.testing.assert_array_equal(greedy_slots, np.ones_like(greedy_slots))
+    assert (a[0::2] == 0).sum() > 300  # random slots explore action 0 sometimes

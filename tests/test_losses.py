@@ -1,0 +1,64 @@
+"""Double-Q target / TD loss / priority unit tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ape_x_dqn_tpu.ops import losses
+
+
+def test_double_q_uses_online_argmax_target_eval():
+    q_online = jnp.asarray([[1.0, 5.0, 2.0]])   # argmax = 1
+    q_target = jnp.asarray([[10.0, 20.0, 30.0]])
+    t = losses.double_q_target(q_online, q_target, jnp.asarray([1.0]), jnp.asarray([0.5]))
+    # 1.0 + 0.5 * q_target[argmax q_online] = 1 + 0.5*20
+    np.testing.assert_allclose(np.asarray(t), [11.0])
+
+
+def test_zero_discount_means_no_bootstrap():
+    q = jnp.ones((2, 4)) * 100.0
+    t = losses.double_q_target(q, q, jnp.asarray([3.0, -1.0]), jnp.zeros(2))
+    np.testing.assert_allclose(np.asarray(t), [3.0, -1.0])
+
+
+def test_max_q_target():
+    q = jnp.asarray([[1.0, 9.0]])
+    t = losses.max_q_target(q, jnp.asarray([1.0]), jnp.asarray([0.1]))
+    np.testing.assert_allclose(np.asarray(t), [1.9], rtol=1e-6)
+
+
+def test_td_error_gathers_taken_action():
+    q = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    d = losses.td_error(q, jnp.asarray([1, 0]), jnp.asarray([0.0, 0.0]))
+    np.testing.assert_allclose(np.asarray(d), [2.0, 3.0])
+
+
+def test_huber_matches_quadratic_inside_kappa():
+    d = jnp.asarray([-0.5, 0.5, 2.0])
+    h = losses.huber(d, kappa=1.0)
+    np.testing.assert_allclose(np.asarray(h)[:2], 0.5 * 0.25, rtol=1e-6)
+    np.testing.assert_allclose(float(h[2]), 0.5 + 1.0 * (2.0 - 1.0), rtol=1e-6)
+
+
+def test_is_weights_scale_loss():
+    d = jnp.asarray([1.0, 1.0])
+    unweighted = losses.td_loss(d, None, kind="squared")
+    weighted = losses.td_loss(d, jnp.asarray([2.0, 2.0]), kind="squared")
+    np.testing.assert_allclose(float(weighted), 2 * float(unweighted))
+
+
+def test_priorities_per_transition_not_collapsed():
+    # Reference collapses batch priorities to one value (SURVEY §2.8).
+    d = jnp.asarray([1.0, -2.0, 3.0])
+    p = losses.priorities_from_td(d, epsilon=0.0)
+    np.testing.assert_allclose(np.asarray(p), [1.0, 2.0, 3.0])
+    assert len(set(np.asarray(p).tolist())) == 3
+
+
+def test_target_is_stop_gradiented():
+    def f(q_next):
+        t = losses.double_q_target(q_next, q_next, jnp.zeros(1), jnp.ones(1))
+        return jnp.sum(t)
+
+    g = jax.grad(f)(jnp.asarray([[1.0, 2.0]]))
+    np.testing.assert_allclose(np.asarray(g), np.zeros((1, 2)))

@@ -1,0 +1,71 @@
+"""Dueling network tests: shapes, aggregation semantics, dtype policy."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ape_x_dqn_tpu.models.dueling import DuelingDQN, DuelingMLP, build_network
+
+
+def _init_apply(net, obs):
+    params = net.init(jax.random.PRNGKey(0), obs)
+    return params, net.apply(params, obs)
+
+
+def test_conv_output_shapes():
+    net = DuelingDQN(num_actions=6, compute_dtype=jnp.float32)
+    obs = jnp.zeros((2, 84, 84, 1), jnp.uint8)
+    _, (v, a, q) = _init_apply(net, obs)
+    assert v.shape == (2, 1)
+    assert a.shape == (2, 6)
+    assert q.shape == (2, 6)
+    assert q.dtype == jnp.float32
+
+
+def test_dueling_aggregation_per_row_mean():
+    # Q = V + A - mean_a(A) per row (intended semantics of the reference's
+    # duelling_network.py:27, which wrongly reduces over the whole batch).
+    net = DuelingMLP(num_actions=3)
+    obs = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    _, (v, a, q) = _init_apply(net, obs)
+    expected = np.asarray(v) + np.asarray(a) - np.asarray(a).mean(axis=1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(q), expected, rtol=1e-5)
+    # identifiability: mean_a Q == V per row
+    np.testing.assert_allclose(
+        np.asarray(q).mean(axis=1), np.asarray(v)[:, 0], rtol=1e-5
+    )
+
+
+def test_aggregation_independent_across_batch():
+    # Row i's Q must not change when other rows change (batch-mean bug guard).
+    net = DuelingMLP(num_actions=3)
+    obs1 = jax.random.normal(jax.random.PRNGKey(2), (2, 8))
+    params = net.init(jax.random.PRNGKey(0), obs1)
+    q_full = net.apply(params, obs1)[2]
+    q_row0 = net.apply(params, obs1[:1])[2]
+    np.testing.assert_allclose(np.asarray(q_full[:1]), np.asarray(q_row0), rtol=1e-5)
+
+
+def test_uint8_and_float_inputs_agree():
+    net = DuelingDQN(num_actions=4, compute_dtype=jnp.float32)
+    obs_u8 = jax.random.randint(jax.random.PRNGKey(3), (1, 84, 84, 1), 0, 255).astype(jnp.uint8)
+    params = net.init(jax.random.PRNGKey(0), obs_u8)
+    q_u8 = net.apply(params, obs_u8)[2]
+    q_f = net.apply(params, obs_u8.astype(jnp.float32) / 255.0)[2]
+    np.testing.assert_allclose(np.asarray(q_u8), np.asarray(q_f), rtol=1e-5)
+
+
+def test_reference_parity_channel_widths():
+    # Reference uses 64/64/64 (SURVEY §2 comp 5); "nature" option gives 32/64/64.
+    assert DuelingDQN(num_actions=4).channels == (64, 64, 64)
+    assert build_network("nature", 4).channels == (32, 64, 64)
+
+
+def test_bfloat16_compute_float32_params():
+    net = DuelingDQN(num_actions=4)  # default bfloat16 compute
+    obs = jnp.zeros((1, 84, 84, 1), jnp.uint8)
+    params = net.init(jax.random.PRNGKey(0), obs)
+    dtypes = {p.dtype for p in jax.tree_util.tree_leaves(params)}
+    assert dtypes == {jnp.dtype(jnp.float32)}
+    q = net.apply(params, obs)[2]
+    assert q.dtype == jnp.float32

@@ -1,0 +1,67 @@
+"""n-step return math vs. a slow oracle (SURVEY §4 test level 1)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.ops.nstep import (
+    build_nstep_transitions,
+    nstep_returns,
+    nstep_returns_reference,
+)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5])
+def test_nstep_returns_match_oracle(rng, n):
+    T = 37
+    rewards = rng.normal(size=T).astype(np.float32)
+    dones = rng.random(T) < 0.15
+    gamma = 0.99
+    discounts = (gamma * (1.0 - dones)).astype(np.float32)
+    got_r, got_d = nstep_returns(jnp.asarray(rewards), jnp.asarray(discounts), n)
+    exp_r, exp_d = nstep_returns_reference(rewards, discounts, n)
+    np.testing.assert_allclose(np.asarray(got_r), exp_r, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_d), exp_d, rtol=1e-5)
+
+
+def test_bootstrap_discount_is_gamma_to_the_n():
+    # The reference stores gamma^(n-1) (SURVEY §2.8); we must store gamma^n.
+    n, gamma = 3, 0.99
+    rewards = jnp.zeros(n)
+    discounts = jnp.full((n,), gamma)
+    _, boot = nstep_returns(rewards, discounts, n)
+    np.testing.assert_allclose(float(boot[0]), gamma**n, rtol=1e-6)
+    assert not np.isclose(float(boot[0]), gamma ** (n - 1))
+
+
+def test_terminal_masks_bootstrap():
+    # A terminal inside the window must zero the bootstrap discount and
+    # truncate the return (no bootstrapping through episode ends).
+    n, gamma = 3, 0.9
+    rewards = jnp.asarray([1.0, 1.0, 1.0, 7.0])
+    discounts = jnp.asarray([gamma, 0.0, gamma, gamma])  # step 1 terminates
+    rets, boot = nstep_returns(rewards, discounts, n)
+    # window starting at 0: r0 + g*r1 + g*0*r2 = 1 + 0.9
+    np.testing.assert_allclose(float(rets[0]), 1.0 + gamma, rtol=1e-6)
+    assert float(boot[0]) == 0.0
+
+
+@pytest.mark.parametrize("stride", [1, 3])
+def test_build_nstep_transitions_shapes_and_alignment(rng, stride):
+    T, n = 12, 3
+    obs = rng.integers(0, 255, size=(T, 4, 4, 1)).astype(np.uint8)
+    tail = rng.integers(0, 255, size=(n, 4, 4, 1)).astype(np.uint8)
+    actions = rng.integers(0, 4, size=T).astype(np.int32)
+    rewards = rng.normal(size=T).astype(np.float32)
+    discounts = np.full(T, 0.99, np.float32)
+    tr = build_nstep_transitions(
+        jnp.asarray(obs), jnp.asarray(actions), jnp.asarray(rewards),
+        jnp.asarray(discounts), jnp.asarray(tail), n=n, stride=stride,
+    )
+    starts = np.arange(0, T - n + 1, stride)
+    assert tr.action.shape == (len(starts),)
+    np.testing.assert_array_equal(np.asarray(tr.obs), obs[starts])
+    np.testing.assert_array_equal(np.asarray(tr.action), actions[starts])
+    # next_obs for start t is obs[t+n] (from concat(obs, tail))
+    all_obs = np.concatenate([obs, tail], axis=0)
+    np.testing.assert_array_equal(np.asarray(tr.next_obs), all_obs[starts + n])
