@@ -22,7 +22,6 @@ params/opt-state update in place in HBM.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Tuple
 
 import jax

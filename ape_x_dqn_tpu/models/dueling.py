@@ -64,9 +64,10 @@ class DuelingDQN(nn.Module):
         # reference's NCHW layout, which otherwise fails deep inside flax.
         if x.ndim != 4:
             raise ValueError(f"expected NHWC [B, H, W, C] observations, got shape {x.shape}")
-        if x.shape[1] <= 4 and x.shape[3] > 4:
-            # Frame stacks have <=4 channels; an axis-1 extent that small with a
-            # large trailing axis is almost certainly channels-first input.
+        if x.shape[1] <= 4 and x.shape[3] > 4 and x.shape[2] == x.shape[3]:
+            # A tiny axis-1 extent with a large *square* trailing pair is the
+            # NCHW frame signature (B, C, H, W); square spatial dims keep
+            # legitimate small-H NHWC inputs like (B, 4, 4, 8) usable.
             raise ValueError(
                 f"observations look NCHW (shape {x.shape}); this framework uses "
                 "NHWC [B, H, W, C] — transpose with x.transpose(0, 2, 3, 1)"

@@ -72,11 +72,11 @@ def build_nstep_transitions(
       actions: int32 [T].
       rewards: float32 [T].
       discounts: float32 [T] — γ·(1−done_t).
-      bootstrap_obs: uint8 [n, *obs_shape] — the ``n`` observations
-        immediately after the segment (S_T .. S_{T+n-1}); S_{t+n} per start
-        index is then sliced from ``concat([obs, bootstrap_obs])``.  At
-        episode boundaries the bootstrap obs content is irrelevant because
-        the bootstrap discount is 0.
+      bootstrap_obs: uint8 [*obs_shape] — the single observation S_T
+        immediately after the segment.  Start indices run 0..T−n, so the
+        bootstrap frames needed are S_n..S_T; all but S_T are sliced from
+        ``obs`` itself.  At episode boundaries the bootstrap obs content is
+        irrelevant because the bootstrap discount is 0.
       n: horizon.
       stride: 1 for overlapping windows (standard Ape-X), ``n`` for the
         reference's non-overlapping emission (reference actor.py:44-70).
@@ -85,7 +85,7 @@ def build_nstep_transitions(
       NStepTransition with batch dim ceil((T-n+1)/stride).
     """
     returns, boot = nstep_returns(rewards, discounts, n)
-    all_obs = jnp.concatenate([obs, bootstrap_obs], axis=0)
+    all_obs = jnp.concatenate([obs, bootstrap_obs[None]], axis=0)
     out_len = returns.shape[0]
     starts = jnp.arange(0, out_len, stride)
     next_obs = all_obs[starts + n]

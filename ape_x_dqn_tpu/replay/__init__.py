@@ -1,0 +1,6 @@
+"""Replay subsystem: sum-tree priorities + prioritized ring-buffer store."""
+
+from ape_x_dqn_tpu.replay.buffer import PrioritizedReplay
+from ape_x_dqn_tpu.replay.sum_tree import SumTree
+
+__all__ = ["PrioritizedReplay", "SumTree"]

@@ -1,0 +1,210 @@
+"""Central prioritized replay — array-backed ring buffer + sum-tree.
+
+Capability parity with the reference's ``ReplayMemory`` (replay.py:8-83), with
+the intended semantics and none of its defects (SURVEY §2.8):
+
+  * proportional prioritization p^α (replay.py:24-30) — but via a sum-tree
+    (O(log N) sample/update) instead of a flat dict + O(N·S) scan;
+  * priority upsert from the learner (replay.py:32-42) — per-transition, not
+    collapsed to a single value;
+  * capacity-bounded FIFO eviction (replay.py:71-80) — implicit in the ring
+    cursor, and a slot's priority dies with its data (the reference leaks
+    stale keys forever);
+  * importance-sampling weights with annealed β — the reference's README-TODO
+    (config key parameters.json:30 read by nothing) built as a first-class
+    capability.
+
+Storage is preallocated numpy: frames stay uint8 end-to-end (a 2M-slot Atari
+buffer is ~28 GB as bytes; float32 would be 4×), scalars in flat arrays.
+Identity is the slot index — the wire format the learner echoes back with new
+priorities (types.PrioritizedBatch.indices).
+
+Thread-safety: one mutex around mutation and sampling.  The Ape-X access
+pattern (many writers, one sampler) hits this lock with *batches* (an actor
+chunk or a learner batch at a time), so lock traffic is O(steps/batch), not
+O(steps) — the discipline that keeps the central replay off the critical path
+(SURVEY §7 "hard parts" #1).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ape_x_dqn_tpu.replay.sum_tree import SumTree
+from ape_x_dqn_tpu.types import NStepTransition, PrioritizedBatch
+
+
+class PrioritizedReplay:
+    """Prioritized n-step transition store.
+
+    Args:
+      capacity: max transitions held (the reference's ``soft_capacity``,
+        parameters.json:28 — hard here: the ring never exceeds it).
+      obs_shape: per-frame observation shape, e.g. (84, 84, 1).
+      priority_exponent: α in p^α (reference parameters.json:29, default 0.6).
+      obs_dtype: storage dtype for frames (uint8 default).
+      sum_tree_cls: injectable tree implementation (numpy or native C++).
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        obs_shape,
+        priority_exponent: float = 0.6,
+        obs_dtype=np.uint8,
+        sum_tree_cls=SumTree,
+    ):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = int(capacity)
+        self.alpha = float(priority_exponent)
+        self._obs = np.zeros((capacity, *obs_shape), dtype=obs_dtype)
+        self._next_obs = np.zeros((capacity, *obs_shape), dtype=obs_dtype)
+        self._action = np.zeros((capacity,), dtype=np.int32)
+        self._reward = np.zeros((capacity,), dtype=np.float32)
+        self._discount = np.zeros((capacity,), dtype=np.float32)
+        self._tree = sum_tree_cls(capacity)
+        self._cursor = 0
+        self._count = 0  # total transitions ever added
+        self._lock = threading.Lock()
+
+    # -- write path (actors / drain) ------------------------------------
+
+    def add(self, priorities: np.ndarray, batch: NStepTransition) -> np.ndarray:
+        """Insert a batch with actor-computed initial priorities
+        (reference replay.py:59-69 ``add(priorities, xp_batch)``).
+
+        Overwrites the oldest slots when full (FIFO).  Returns the slot
+        indices written.
+        """
+        priorities = np.asarray(priorities, dtype=np.float64)
+        n = priorities.shape[0]
+        if n == 0:
+            return np.zeros((0,), np.int64)
+        if n > self.capacity:
+            raise ValueError(f"batch of {n} exceeds capacity {self.capacity}")
+        with self._lock:
+            idx = (self._cursor + np.arange(n)) % self.capacity
+            self._obs[idx] = batch.obs
+            self._next_obs[idx] = batch.next_obs
+            self._action[idx] = batch.action
+            self._reward[idx] = batch.reward
+            self._discount[idx] = batch.discount
+            self._tree.set(idx, np.power(np.maximum(priorities, 1e-12), self.alpha))
+            self._cursor = int((self._cursor + n) % self.capacity)
+            self._count += n
+            return idx
+
+    # -- read path (learner) --------------------------------------------
+
+    def sample(
+        self,
+        batch_size: int,
+        beta: float = 0.4,
+        rng: Optional[np.random.Generator] = None,
+    ) -> PrioritizedBatch:
+        """Stratified proportional sample with IS weights.
+
+        P(i) = p_i^α / Σ p^α;  w_i = (N · P(i))^−β, normalized by max w
+        (the standard PER correction the reference lists as TODO, β from
+        parameters.json:30).
+        """
+        rng = rng or np.random.default_rng()
+        with self._lock:
+            size = min(self._count, self.capacity)
+            if size == 0:
+                raise ValueError("cannot sample from an empty replay")
+            idx = self._tree.sample_stratified(batch_size, rng)
+            mass = self._tree.get(idx)
+            total = self._tree.total
+            transition = NStepTransition(
+                obs=self._obs[idx].copy(),
+                action=self._action[idx].copy(),
+                reward=self._reward[idx].copy(),
+                discount=self._discount[idx].copy(),
+                next_obs=self._next_obs[idx].copy(),
+            )
+        probs = mass / total
+        weights = np.power(size * np.maximum(probs, 1e-12), -beta)
+        weights = (weights / weights.max()).astype(np.float32)
+        return PrioritizedBatch(
+            transition=transition,
+            indices=idx.astype(np.int32),
+            is_weights=weights,
+        )
+
+    def update_priorities(self, indices: np.ndarray, priorities: np.ndarray) -> None:
+        """Learner priority feedback (reference ``set_priorities``,
+        replay.py:32 — here per-transition and O(B log N)).
+
+        If a sampled slot was recycled between sample and update, the fresh
+        transition briefly carries the old transition's updated priority —
+        a benign, self-correcting race (the slot is resampled and restamped
+        within a few steps), and the same whole-value-atomicity discipline
+        the reference relies on (SURVEY §5 race detection).
+        """
+        indices = np.asarray(indices, dtype=np.int64)
+        priorities = np.asarray(priorities, dtype=np.float64)
+        if indices.size == 0:
+            return
+        with self._lock:
+            self._tree.set(
+                indices, np.power(np.maximum(priorities, 1e-12), self.alpha)
+            )
+
+    # -- misc ------------------------------------------------------------
+
+    def size(self) -> int:
+        """Current number of stored transitions (reference replay.py:82)."""
+        with self._lock:
+            return min(self._count, self.capacity)
+
+    @property
+    def total_added(self) -> int:
+        return self._count
+
+    def max_priority(self) -> float:
+        with self._lock:
+            m = self._tree.max_priority()
+        return float(m ** (1.0 / self.alpha)) if m > 0 else 1.0
+
+    # -- snapshot (checkpointing) ----------------------------------------
+
+    def state_dict(self) -> dict:
+        """Snapshot for checkpoint/resume (the reference checkpoints nothing
+        of the replay — SURVEY §5 checkpoint/resume)."""
+        with self._lock:
+            size = min(self._count, self.capacity)
+            idx = np.arange(size)
+            return {
+                "obs": self._obs[:size].copy(),
+                "next_obs": self._next_obs[:size].copy(),
+                "action": self._action[:size].copy(),
+                "reward": self._reward[:size].copy(),
+                "discount": self._discount[:size].copy(),
+                "tree_priorities": self._tree.get(idx),
+                "cursor": self._cursor,
+                "count": self._count,
+            }
+
+    def load_state_dict(self, state: dict) -> None:
+        with self._lock:
+            size = state["obs"].shape[0]
+            if size > self.capacity:
+                raise ValueError("snapshot larger than capacity")
+            # Clear everything first so a restore into a warm buffer cannot
+            # leave stale transitions sampleable past the snapshot region.
+            self._tree.set(
+                np.arange(self.capacity), np.zeros(self.capacity, np.float64)
+            )
+            self._obs[:size] = state["obs"]
+            self._next_obs[:size] = state["next_obs"]
+            self._action[:size] = state["action"]
+            self._reward[:size] = state["reward"]
+            self._discount[:size] = state["discount"]
+            self._tree.set(np.arange(size), state["tree_priorities"])
+            self._cursor = int(state["cursor"]) % self.capacity
+            self._count = int(state["count"])

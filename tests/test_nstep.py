@@ -50,7 +50,7 @@ def test_terminal_masks_bootstrap():
 def test_build_nstep_transitions_shapes_and_alignment(rng, stride):
     T, n = 12, 3
     obs = rng.integers(0, 255, size=(T, 4, 4, 1)).astype(np.uint8)
-    tail = rng.integers(0, 255, size=(n, 4, 4, 1)).astype(np.uint8)
+    tail = rng.integers(0, 255, size=(4, 4, 1)).astype(np.uint8)  # S_T only
     actions = rng.integers(0, 4, size=T).astype(np.int32)
     rewards = rng.normal(size=T).astype(np.float32)
     discounts = np.full(T, 0.99, np.float32)
@@ -63,5 +63,5 @@ def test_build_nstep_transitions_shapes_and_alignment(rng, stride):
     np.testing.assert_array_equal(np.asarray(tr.obs), obs[starts])
     np.testing.assert_array_equal(np.asarray(tr.action), actions[starts])
     # next_obs for start t is obs[t+n] (from concat(obs, tail))
-    all_obs = np.concatenate([obs, tail], axis=0)
+    all_obs = np.concatenate([obs, tail[None]], axis=0)
     np.testing.assert_array_equal(np.asarray(tr.next_obs), all_obs[starts + n])

@@ -1,0 +1,188 @@
+"""Replay subsystem tests: sum-tree invariants + prioritized buffer semantics.
+
+SURVEY §4 test level 1 (sum-tree invariants) and the intended central-replay
+semantics of reference replay.py (proportional p^α sampling, priority upsert,
+FIFO eviction with priorities evicted too, IS weights)."""
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.replay import PrioritizedReplay, SumTree
+from ape_x_dqn_tpu.types import NStepTransition
+
+
+def make_batch(n, obs_shape=(4, 4, 1), seed=0):
+    r = np.random.default_rng(seed)
+    return NStepTransition(
+        obs=r.integers(0, 255, (n, *obs_shape), dtype=np.uint8),
+        action=r.integers(0, 4, (n,), dtype=np.int32),
+        reward=r.normal(size=(n,)).astype(np.float32),
+        discount=np.full((n,), 0.9, np.float32),
+        next_obs=r.integers(0, 255, (n, *obs_shape), dtype=np.uint8),
+    )
+
+
+class TestSumTree:
+    def test_total_matches_sum(self, rng):
+        t = SumTree(100)
+        idx = rng.permutation(100)[:50]
+        pri = rng.random(50)
+        t.set(idx, pri)
+        assert np.isclose(t.total, pri.sum())
+        assert np.allclose(t.get(idx), pri)
+
+    def test_overwrite_updates_total(self):
+        t = SumTree(8)
+        t.set(np.arange(8), np.ones(8))
+        t.set(np.array([3]), np.array([5.0]))
+        assert np.isclose(t.total, 7 + 5)
+
+    def test_duplicate_indices_last_write_wins(self):
+        t = SumTree(4)
+        t.set(np.array([2, 2, 2]), np.array([1.0, 7.0, 3.0]))
+        assert t.get(np.array([2]))[0] == 3.0
+        assert np.isclose(t.total, 3.0)
+
+    def test_non_pow2_capacity(self):
+        t = SumTree(5)
+        t.set(np.arange(5), np.arange(1.0, 6.0))
+        assert np.isclose(t.total, 15.0)
+
+    def test_sample_inverse_cdf_exact(self):
+        t = SumTree(4)
+        t.set(np.arange(4), np.array([1.0, 2.0, 3.0, 4.0]))
+        # Prefix intervals: [0,1) [1,3) [3,6) [6,10)
+        targets = np.array([0.5, 1.0, 2.99, 3.0, 5.999, 6.0, 9.999])
+        assert list(t.sample(targets)) == [0, 1, 1, 2, 2, 3, 3]
+
+    def test_sampling_distribution_proportional(self, rng):
+        t = SumTree(16)
+        pri = np.arange(1.0, 17.0)
+        t.set(np.arange(16), pri)
+        idx = t.sample_stratified(200_000, rng)
+        freq = np.bincount(idx, minlength=16) / 200_000
+        assert np.allclose(freq, pri / pri.sum(), atol=5e-3)
+
+    def test_zero_mass_leaf_never_sampled(self, rng):
+        t = SumTree(8)
+        t.set(np.array([1, 5]), np.array([3.0, 2.0]))
+        idx = t.sample_stratified(10_000, rng)
+        assert set(np.unique(idx)) <= {1, 5}
+
+    def test_rejects_bad_input(self):
+        t = SumTree(4)
+        with pytest.raises(IndexError):
+            t.set(np.array([4]), np.array([1.0]))
+        with pytest.raises(ValueError):
+            t.set(np.array([0]), np.array([-1.0]))
+        with pytest.raises(ValueError):
+            t.set(np.array([0]), np.array([np.nan]))
+        with pytest.raises(ValueError):
+            t.sample_stratified(4, np.random.default_rng(0))
+
+
+class TestPrioritizedReplay:
+    def test_add_and_size(self):
+        rep = PrioritizedReplay(64, (4, 4, 1))
+        rep.add(np.ones(10), make_batch(10))
+        assert rep.size() == 10
+
+    def test_roundtrip_contents(self):
+        rep = PrioritizedReplay(64, (4, 4, 1))
+        batch = make_batch(8, seed=3)
+        rep.add(np.full(8, 1.0), batch)
+        out = rep.sample(32, rng=np.random.default_rng(0))
+        # Every sampled transition must be one we inserted, intact.
+        for j in range(32):
+            i = int(out.indices[j])
+            assert np.array_equal(out.transition.obs[j], batch.obs[i])
+            assert out.transition.action[j] == batch.action[i]
+            assert out.transition.reward[j] == pytest.approx(float(batch.reward[i]))
+            assert np.array_equal(out.transition.next_obs[j], batch.next_obs[i])
+
+    def test_fifo_eviction_evicts_priorities(self):
+        """Reference defect (SURVEY §2.8): evicted keys' priorities leak
+        forever.  Here an overwritten slot carries ONLY its new priority."""
+        rep = PrioritizedReplay(4, (2, 2, 1))
+        rep.add(np.full(4, 100.0), make_batch(4, (2, 2, 1), seed=1))
+        # Wrap: 2 new transitions with tiny priority overwrite slots 0-1.
+        rep.add(np.full(2, 1e-6), make_batch(2, (2, 2, 1), seed=2))
+        assert rep.size() == 4
+        # Slots 0,1 now hold the tiny priorities, not the old 100s.
+        tree_mass = rep._tree.get(np.array([0, 1]))
+        assert np.all(tree_mass < 1.0)
+
+    def test_proportional_sampling_respects_alpha(self, rng):
+        rep = PrioritizedReplay(2, (2, 2, 1), priority_exponent=0.5)
+        rep.add(np.array([1.0, 16.0]), make_batch(2, (2, 2, 1)))
+        out_counts = np.zeros(2)
+        for _ in range(200):
+            out = rep.sample(64, rng=rng)
+            out_counts += np.bincount(out.indices, minlength=2)
+        # p^0.5 → masses 1:4 → slot 1 sampled ~80%.
+        frac = out_counts[1] / out_counts.sum()
+        assert abs(frac - 0.8) < 0.02
+
+    def test_is_weights(self, rng):
+        rep = PrioritizedReplay(4, (2, 2, 1), priority_exponent=1.0)
+        rep.add(np.array([1.0, 1.0, 2.0, 4.0]), make_batch(4, (2, 2, 1)))
+        out = rep.sample(256, beta=1.0, rng=rng)
+        # w_i ∝ 1/P(i); rarest transition gets weight 1 (max-normalized).
+        rare = out.is_weights[out.indices <= 1]
+        common = out.is_weights[out.indices == 3]
+        assert rare.size and common.size
+        assert np.allclose(rare, 1.0)
+        assert np.allclose(common, 0.25)
+
+    def test_update_priorities_changes_distribution(self, rng):
+        rep = PrioritizedReplay(2, (2, 2, 1), priority_exponent=1.0)
+        rep.add(np.array([1.0, 1.0]), make_batch(2, (2, 2, 1)))
+        rep.update_priorities(np.array([0]), np.array([1e4]))
+        out = rep.sample(1000, rng=rng)
+        assert np.mean(out.indices == 0) > 0.99
+
+    def test_empty_sample_raises(self):
+        rep = PrioritizedReplay(4, (2, 2, 1))
+        with pytest.raises(ValueError):
+            rep.sample(4)
+
+    def test_snapshot_roundtrip(self, rng):
+        rep = PrioritizedReplay(16, (2, 2, 1))
+        rep.add(rng.random(10) + 0.1, make_batch(10, (2, 2, 1), seed=5))
+        state = rep.state_dict()
+        rep2 = PrioritizedReplay(16, (2, 2, 1))
+        rep2.load_state_dict(state)
+        assert rep2.size() == 10
+        assert np.isclose(rep2._tree.total, rep._tree.total)
+        out = rep2.sample(8, rng=np.random.default_rng(1))
+        assert out.transition.obs.shape == (8, 2, 2, 1)
+
+    def test_threaded_add_sample_update(self):
+        """Many writers + one sampler/updater, no crashes, sane state."""
+        import threading
+
+        rep = PrioritizedReplay(512, (2, 2, 1))
+        rep.add(np.ones(32), make_batch(32, (2, 2, 1)))
+        stop = threading.Event()
+        errors = []
+
+        def writer(seed):
+            try:
+                r = np.random.default_rng(seed)
+                while not stop.is_set():
+                    rep.add(r.random(16) + 0.01, make_batch(16, (2, 2, 1), seed=seed))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(s,)) for s in range(4)]
+        for t in threads:
+            t.start()
+        r = np.random.default_rng(9)
+        for _ in range(50):
+            out = rep.sample(64, rng=r)
+            rep.update_priorities(out.indices, np.abs(r.normal(size=64)) + 0.01)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert rep.size() == 512
