@@ -1,0 +1,11 @@
+"""Actor layer: batched fleets producing prioritized n-step experience."""
+
+from ape_x_dqn_tpu.actors.pool import (
+    ActorFleet,
+    Chunk,
+    EpisodeStat,
+    LocalParamSource,
+    build_policy_step,
+)
+
+__all__ = ["ActorFleet", "Chunk", "EpisodeStat", "LocalParamSource", "build_policy_step"]

@@ -1,0 +1,280 @@
+"""The actor fleet: batched rollouts, ε-ladder, n-step emission, priorities.
+
+The reference runs each actor as its own OS process doing batch-1 torch
+inference with a per-step ``print`` on the hot path (reference
+actor.py:146-191).  That pattern can't feed a TPU learner (SURVEY §7 hard
+parts #3).  The TPU-native inversion implemented here:
+
+  * **One fleet, one forward.**  N actor envs step in lockstep
+    (``SyncVectorEnv``); action selection for the whole fleet is a single
+    jitted ``policy_step`` (forward + vectorized ε-greedy) — batch = N rides
+    the MXU, one host↔device round trip per fleet step instead of N.
+  * **ε-ladder preserved**: actor i uses ε^(1+α·i/(N−1)) (reference
+    actor.py:111-114), materialized once as a device vector.
+  * **Sliding-window n-step with zero extra forwards.**  The fleet keeps a
+    host-side history ring of the last ``flush_every + n`` steps (obs,
+    action, reward, discount, q-values).  Every ``flush_every`` steps it
+    emits ``flush_every`` *overlapping* n-step transitions per actor
+    (stride 1 — the paper's emission; the reference's non-overlapping
+    window is stride=n, SURVEY §2 component 3) and computes initial
+    priorities |R + D·max_a Q(S_{t+n}) − Q(S_t)[A_t]| (the reference's
+    max-Q actor rule, actor.py:138-142) **from the q-values already computed
+    during action selection** — no second forward pass.
+  * Episode boundaries: per-step discount γ·(1−done) folds terminal masking
+    into the return math (defect fixed vs. reference, SURVEY §2.8).
+    Truncation (time limits) is treated as termination for the window math —
+    the standard DQN simplification; the env layer still reports both so
+    metrics distinguish them.
+
+Parameter sync mirrors reference actor.py:189-191 (poll every
+``sync_every`` fleet steps) against a ``ParamSource`` — any object with a
+``get(current_version) -> (params, version) | None`` method (the runtime's
+versioned param store, or a trivial local stub in tests).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, NamedTuple, Sequence
+
+import jax
+import numpy as np
+
+from ape_x_dqn_tpu.envs.vector import SyncVectorEnv
+from ape_x_dqn_tpu.ops.exploration import epsilon_greedy, epsilon_ladder
+from ape_x_dqn_tpu.ops.nstep import nstep_returns_np
+from ape_x_dqn_tpu.types import NStepTransition
+
+
+class Chunk(NamedTuple):
+    """One flush: transitions + actor-computed initial priorities."""
+
+    priorities: np.ndarray        # float32 [M]
+    transitions: NStepTransition  # numpy leaves, batch M
+    actor_steps: int              # fleet env steps this chunk covers
+
+
+class EpisodeStat(NamedTuple):
+    actor_id: int
+    episode_return: float
+    episode_length: int
+
+
+def build_policy_step(network, seed: int = 0) -> Callable:
+    """Jitted fleet policy: forward + ε-greedy in one XLA program.
+
+    Returns ``(params, obs, epsilons, step) -> (actions, q_values)``; the
+    PRNG key is derived in-graph by folding the step counter into the
+    seed-derived base key, so the host passes only an int — no key
+    threading, and distinct seeds give independent exploration streams.
+    """
+
+    @jax.jit
+    def policy_step(params, obs, epsilons, step):
+        q = network.apply(params, obs)[2]
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        actions = epsilon_greedy(rng, q, epsilons)
+        return actions, q
+
+    return policy_step
+
+
+class ActorFleet:
+    """N lockstep actors producing prioritized n-step chunks.
+
+    Args:
+      env_fns: one constructor per actor (reference: ``num_actors``,
+        parameters.json:9).
+      network: the Q-network (flax module) used for action selection.
+      n_step: the n-step horizon (reference ``num_steps``=3).
+      gamma: discount (reference parameters.json:14).
+      epsilon/epsilon_alpha: ε-ladder parameters (reference 0.4 / 7).
+      flush_every: fleet steps between chunk emissions — the analogue of the
+        reference's ``n_step_transition_batch_size``=5 flush gate
+        (actor.py:181-187), but measured in steps, emitting
+        ``flush_every × N`` transitions per flush.
+      sync_every: fleet steps between parameter-store polls (reference
+        ``Q_network_sync_freq``=500, actor.py:189-191).
+    """
+
+    def __init__(
+        self,
+        env_fns: Sequence[Callable],
+        network,
+        n_step: int = 3,
+        gamma: float = 0.99,
+        epsilon: float = 0.4,
+        epsilon_alpha: float = 7.0,
+        flush_every: int = 16,
+        sync_every: int = 500,
+        seed: int = 0,
+    ):
+        self.envs = SyncVectorEnv(env_fns)
+        self.network = network
+        self.n_step = int(n_step)
+        self.gamma = float(gamma)
+        self.flush_every = int(flush_every)
+        self.sync_every = int(sync_every)
+        N = self.envs.num_envs
+        self._epsilons = epsilon_ladder(epsilon, epsilon_alpha, N)
+        self._policy_step = build_policy_step(network, seed=seed)
+        self._obs = self.envs.reset(seed=seed)
+        # History ring: H = flush_every + n rows; global step s lives at
+        # slot s % H (rotating cursor — no per-step memmove of obs history).
+        H = self.flush_every + self.n_step
+        obs_shape = self.envs.observation_shape
+        self._H = H
+        self._hist_obs = np.zeros((H, N, *obs_shape), np.uint8)
+        self._hist_action = np.zeros((H, N), np.int32)
+        self._hist_reward = np.zeros((H, N), np.float32)
+        self._hist_discount = np.zeros((H, N), np.float32)
+        self._hist_qmax = np.zeros((H, N), np.float32)
+        self._hist_qtaken = np.zeros((H, N), np.float32)
+        self._rows = 0          # valid rows in history (grows to H, then stays)
+        self._step_count = 0    # total fleet steps
+        self.params = None
+        self.param_version = -1
+
+    @property
+    def num_actors(self) -> int:
+        return self.envs.num_envs
+
+    @property
+    def step_count(self) -> int:
+        """Total fleet steps taken (== per-actor env steps, lockstep)."""
+        return self._step_count
+
+    def sync_params(self, source) -> bool:
+        """Poll the param source; returns True if new params were adopted.
+
+        Snapshots arrive as host (numpy) pytrees — the store's wire format —
+        and are uploaded to device once here, so the per-step policy call
+        never re-transfers params.
+        """
+        got = source.get(self.param_version)
+        if got is None:
+            return False
+        params, self.param_version = got
+        self.params = jax.device_put(params)
+        return True
+
+    def _roll_in(self, obs, action, reward, discount, qmax, qtaken):
+        """Write one fleet step at the rotating cursor slot s % H."""
+        slot = self._step_count % self._H
+        self._hist_obs[slot] = obs
+        self._hist_action[slot] = action
+        self._hist_reward[slot] = reward
+        self._hist_discount[slot] = discount
+        self._hist_qmax[slot] = qmax
+        self._hist_qtaken[slot] = qtaken
+        self._rows = min(self._rows + 1, self._H)
+
+    def _flush(self) -> Chunk:
+        """Emit flush_every overlapping n-step transitions per actor from the
+        history ring.  Requires a full ring (_rows == H).
+
+        Called after ``_step_count`` was incremented past the newest row, so
+        the oldest row (global step ``_step_count − H``) lives at slot
+        ``_step_count % H``; ``order`` gathers rows oldest→newest once per
+        flush (amortized ~H/F rows of copy per step, vs. H rows per step for
+        a shift-down ring).
+        """
+        n, F, N = self.n_step, self.flush_every, self.num_actors
+        order = (np.arange(self._H) + self._step_count) % self._H
+        # Window starts 0..F-1; start+n <= H-1 indexes stay in the ring.
+        rewards = self._hist_reward[order[: F + n - 1]]
+        discounts = self._hist_discount[order[: F + n - 1]]
+        returns, boot = nstep_returns_np(rewards, discounts, n)  # [F, N]
+        next_idx = order[np.arange(F) + n]
+        obs = self._hist_obs[order[:F]]                # [F, N, *obs]
+        next_obs = self._hist_obs[next_idx]            # [F, N, *obs]
+        qtaken = self._hist_qtaken[order[:F]]
+        boot_qmax = self._hist_qmax[next_idx]
+        # Actor priority rule: |n-step TD error| with max-Q bootstrap
+        # (reference actor.py:138-142), per transition (not collapsed).
+        td = returns + boot * boot_qmax - qtaken
+        priorities = np.abs(td).astype(np.float32).reshape(-1)
+        transitions = NStepTransition(
+            obs=obs.reshape(F * N, *obs.shape[2:]),
+            action=self._hist_action[order[:F]].reshape(-1),
+            reward=returns.reshape(-1).astype(np.float32),
+            discount=boot.reshape(-1).astype(np.float32),
+            next_obs=next_obs.reshape(F * N, *next_obs.shape[2:]),
+        )
+        return Chunk(priorities, transitions, F * N)
+
+    def collect(
+        self,
+        num_steps: int,
+        param_source=None,
+    ) -> tuple[List[Chunk], List[EpisodeStat]]:
+        """Run ``num_steps`` fleet steps; return emitted chunks + episode
+        stats.  The synchronous core — the async runtime wraps this in a
+        thread; the deterministic test mode calls it directly.
+        """
+        if self.params is None:
+            if param_source is None or not self.sync_params(param_source):
+                raise RuntimeError(
+                    "ActorFleet has no params — call sync_params or pass param_source"
+                )
+        chunks: List[Chunk] = []
+        stats: List[EpisodeStat] = []
+        for _ in range(num_steps):
+            actions_d, q_d = self._policy_step(
+                self.params, self._obs, self._epsilons, self._step_count
+            )
+            actions = np.asarray(actions_d)
+            q = np.asarray(q_d)
+            vs = self.envs.step(actions)
+            done = vs.terminated | vs.truncated
+            discount = (self.gamma * (1.0 - done)).astype(np.float32)
+            self._roll_in(
+                self._obs,
+                actions,
+                vs.reward,
+                discount,
+                q.max(axis=-1),
+                np.take_along_axis(q, actions[:, None], axis=-1)[:, 0],
+            )
+            self._obs = vs.reset_obs
+            self._step_count += 1
+            for i in np.nonzero(~np.isnan(vs.episode_return))[0]:
+                stats.append(
+                    EpisodeStat(int(i), float(vs.episode_return[i]), int(vs.episode_length[i]))
+                )
+            # Flush on ring-fill, then every flush_every steps after — this
+            # phase alignment emits every global step as a window start
+            # exactly once (flushing on step % flush_every instead would
+            # silently drop the first few steps whenever n % flush_every != 0).
+            if (
+                self._rows == self._H
+                and (self._step_count - self._H) % self.flush_every == 0
+            ):
+                chunks.append(self._flush())
+            if param_source is not None and self._step_count % self.sync_every == 0:
+                self.sync_params(param_source)
+        return chunks, stats
+
+
+class LocalParamSource:
+    """Trivial in-process param source for tests and the single-process
+    driver — the analogue of the reference's manager dict
+    (main.py:38, actor.py:106) without the serialization.
+
+    Snapshots are stored as host numpy pytrees (``jax.device_get`` at
+    publish).  This is load-bearing, not just the wire format: the learner's
+    train step donates its state buffers, so publishing live device arrays
+    would hand actors references that die on the next update.
+    """
+
+    def __init__(self, params=None):
+        self._params = jax.device_get(params) if params is not None else None
+        self._version = 0 if params is not None else -1
+
+    def publish(self, params):
+        self._params = jax.device_get(params)
+        self._version += 1
+
+    def get(self, current_version: int):
+        if self._params is None or self._version <= current_version:
+            return None
+        return self._params, self._version

@@ -1,0 +1,246 @@
+"""Typed configuration — the reference's parameters.json vocabulary, validated.
+
+The reference's entire config system is one JSON file fetched by string key
+with no schema, one dead key, and the learner's total step count hard-coded
+outside config (reference parameters.json:1-34, main.py:12-16,29-33,46 —
+SURVEY §2 component 9).  Here the same four-section vocabulary
+(``env_conf`` / ``Actor`` / ``Learner`` / ``Replay_Memory``) becomes typed
+dataclasses with validation; reference-format JSON files load directly, every
+key is consumed, and CLI ``--set section.field=value`` overrides layer on
+top.
+
+Key-by-key mapping from the reference file (parameters.json):
+  env_conf.name/state_shape/action_dim      → EnvConfig (state_shape/action_dim
+    become optional: they are *derived* from the constructed env and only
+    validated if given — the reference trusts them blindly)
+  Actor.num_actors/T/num_steps/epsilon/alpha/gamma → ActorConfig (same names)
+  Actor.n_step_transition_batch_size        → ActorConfig.flush_every (steps
+    between chunk emissions; the reference counts buffered transitions)
+  Actor.Q_network_sync_freq                 → ActorConfig.sync_every
+  Learner.q_target_sync_freq/min_replay_mem_size/replay_sample_size
+                                            → LearnerConfig (same names)
+  Learner.load_saved_state                  → LearnerConfig.restore_from
+  Learner.remove_old_xp_freq                → accepted, no-op: the ring
+    buffer evicts FIFO implicitly on overwrite (reference replay.py:71-80's
+    periodic scan is structural, not semantic)
+  Learner T (hard-coded 500000 at main.py:46) → LearnerConfig.total_steps,
+    in config where it belonged
+  Replay_Memory.soft_capacity               → ReplayConfig.capacity (hard)
+  Replay_Memory.priority_exponent           → ReplayConfig.priority_exponent
+  Replay_Memory.importance_sampling_exponent → ReplayConfig.is_exponent —
+    read by nothing in the reference (README TODO); live here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional, Sequence
+
+
+@dataclasses.dataclass
+class EnvConfig:
+    name: str = "chain:10"
+    state_shape: Optional[Sequence[int]] = None   # validated if given
+    action_dim: Optional[int] = None              # validated if given
+    frame_skip: int = 4
+    frame_stack: int = 1       # reference parity: single frame (SURVEY §2 comp 5)
+    episodic_life: bool = True
+    clip_rewards: bool = True
+
+
+@dataclasses.dataclass
+class ActorConfig:
+    num_actors: int = 5                   # parameters.json:9
+    T: int = 50_000                       # per-actor env steps, parameters.json:10
+    num_steps: int = 3                    # n-step horizon, parameters.json:11
+    epsilon: float = 0.4                  # parameters.json:12
+    alpha: float = 7.0                    # ε-ladder exponent, parameters.json:13
+    gamma: float = 0.99                   # parameters.json:14
+    flush_every: int = 16                 # chunk emission period (steps)
+    sync_every: int = 500                 # param poll period, parameters.json:16
+
+
+@dataclasses.dataclass
+class LearnerConfig:
+    total_steps: int = 500_000            # reference main.py:46 (hard-coded there)
+    q_target_sync_freq: int = 2500        # parameters.json:21
+    min_replay_mem_size: int = 20_000     # parameters.json:22
+    replay_sample_size: int = 32          # parameters.json:23
+    restore_from: str | bool = False      # parameters.json:24 load_saved_state
+    optimizer: str = "rmsprop"            # "rmsprop" (parity) | "adam"
+    learning_rate: float = 0.00025 / 4    # reference learner.py:26
+    loss: str = "huber"                   # "huber" | "squared" (parity)
+    max_grad_norm: Optional[float] = 40.0
+    publish_every: int = 10               # param-store publish period (steps);
+    # the reference republishes the full state_dict EVERY step while actors
+    # poll every 500 (learner.py:74 vs actor.py:189) — a push-always/
+    # pull-rarely mismatch this cap fixes (SURVEY §2 backend entry).
+    checkpoint_every: int = 0             # steps; 0 disables
+    checkpoint_dir: str = "checkpoints"
+
+
+@dataclasses.dataclass
+class ReplayConfig:
+    capacity: int = 100_000               # parameters.json:28 soft_capacity
+    priority_exponent: float = 0.6        # parameters.json:29
+    is_exponent: float = 0.4              # parameters.json:30 (dead there, live here)
+
+
+@dataclasses.dataclass
+class ApexConfig:
+    env: EnvConfig = dataclasses.field(default_factory=EnvConfig)
+    actor: ActorConfig = dataclasses.field(default_factory=ActorConfig)
+    learner: LearnerConfig = dataclasses.field(default_factory=LearnerConfig)
+    replay: ReplayConfig = dataclasses.field(default_factory=ReplayConfig)
+    network: str = "conv"                 # "conv" | "nature" | "mlp"
+    seed: int = 0
+
+    def validate(self) -> "ApexConfig":
+        a, l, r = self.actor, self.learner, self.replay
+        checks = [
+            (a.num_actors >= 1, "actor.num_actors must be >= 1"),
+            (a.num_steps >= 1, "actor.num_steps must be >= 1"),
+            (0.0 <= a.epsilon <= 1.0, "actor.epsilon must be in [0, 1]"),
+            (0.0 < a.gamma <= 1.0, "actor.gamma must be in (0, 1]"),
+            (a.flush_every >= 1, "actor.flush_every must be >= 1"),
+            (a.sync_every >= 1, "actor.sync_every must be >= 1"),
+            (l.publish_every >= 1, "learner.publish_every must be >= 1"),
+            (l.replay_sample_size >= 1, "learner.replay_sample_size must be >= 1"),
+            (l.q_target_sync_freq >= 1, "learner.q_target_sync_freq must be >= 1"),
+            (r.capacity >= l.replay_sample_size,
+             "replay.capacity must be >= learner.replay_sample_size"),
+            (l.min_replay_mem_size <= r.capacity,
+             "learner.min_replay_mem_size must be <= replay.capacity"),
+            (0.0 <= r.priority_exponent <= 1.0,
+             "replay.priority_exponent must be in [0, 1]"),
+            (0.0 <= r.is_exponent <= 1.0, "replay.is_exponent must be in [0, 1]"),
+            (self.network in ("conv", "nature", "mlp"),
+             f"unknown network kind: {self.network}"),
+            (l.optimizer in ("rmsprop", "adam"),
+             f"unknown optimizer kind: {l.optimizer}"),
+            (l.loss in ("huber", "squared"), f"unknown loss kind: {l.loss}"),
+        ]
+        for ok, msg in checks:
+            if not ok:
+                raise ValueError(msg)
+        return self
+
+
+_REFERENCE_KEY_MAP = {
+    # (reference section, reference key) -> (section attr, field, transform)
+    ("env_conf", "name"): ("env", "name", str),
+    ("env_conf", "state_shape"): ("env", "state_shape", tuple),
+    ("env_conf", "action_dim"): ("env", "action_dim", int),
+    ("Actor", "num_actors"): ("actor", "num_actors", int),
+    ("Actor", "T"): ("actor", "T", int),
+    ("Actor", "num_steps"): ("actor", "num_steps", int),
+    ("Actor", "epsilon"): ("actor", "epsilon", float),
+    ("Actor", "alpha"): ("actor", "alpha", float),
+    ("Actor", "gamma"): ("actor", "gamma", float),
+    ("Actor", "n_step_transition_batch_size"): ("actor", "flush_every", int),
+    ("Actor", "Q_network_sync_freq"): ("actor", "sync_every", int),
+    ("Learner", "T"): ("learner", "total_steps", int),
+    ("Learner", "q_target_sync_freq"): ("learner", "q_target_sync_freq", int),
+    ("Learner", "min_replay_mem_size"): ("learner", "min_replay_mem_size", int),
+    ("Learner", "replay_sample_size"): ("learner", "replay_sample_size", int),
+    ("Learner", "load_saved_state"): ("learner", "restore_from", lambda v: v),
+    ("Learner", "remove_old_xp_freq"): (None, None, None),  # no-op (ring evicts)
+    ("Replay_Memory", "soft_capacity"): ("replay", "capacity", int),
+    ("Replay_Memory", "priority_exponent"): ("replay", "priority_exponent", float),
+    ("Replay_Memory", "importance_sampling_exponent"): ("replay", "is_exponent", float),
+}
+
+
+def from_reference_json(data: dict) -> ApexConfig:
+    """Load a reference-format parameters.json dict.  Unknown keys raise
+    (no silently-dead config — SURVEY §5 config subsystem)."""
+    cfg = ApexConfig()
+    for section, keys in data.items():
+        if not isinstance(keys, dict):
+            raise ValueError(f"unknown top-level config entry: {section}")
+        for key, value in keys.items():
+            mapping = _REFERENCE_KEY_MAP.get((section, key))
+            if mapping is None:
+                raise ValueError(f"unknown config key: {section}.{key}")
+            attr, field, transform = mapping
+            if attr is None:
+                continue  # documented no-op
+            setattr(getattr(cfg, attr), field, transform(value))
+    return cfg.validate()
+
+
+def _coerce(current: Any, raw: str) -> Any:
+    if isinstance(current, bool):
+        # bool-defaulted fields may be str|bool unions (learner.restore_from:
+        # False or a checkpoint path) — only coerce clearly boolean words,
+        # pass anything else through as a string.
+        low = raw.lower()
+        if low in ("1", "true", "yes"):
+            return True
+        if low in ("0", "false", "no"):
+            return False
+        return raw
+    if isinstance(current, int):
+        return int(raw)
+    if isinstance(current, float):
+        return float(raw)
+    return raw
+
+
+def apply_overrides(cfg: ApexConfig, overrides: Sequence[str]) -> ApexConfig:
+    """Apply CLI ``section.field=value`` overrides (e.g.
+    ``actor.num_actors=64``, ``network=mlp``)."""
+    for item in overrides:
+        if "=" not in item:
+            raise ValueError(f"override must be key=value, got: {item}")
+        path, raw = item.split("=", 1)
+        parts = path.split(".")
+        obj = cfg
+        for p in parts[:-1]:
+            if not hasattr(obj, p):
+                raise ValueError(f"unknown config path: {path}")
+            obj = getattr(obj, p)
+        field = parts[-1]
+        if not hasattr(obj, field):
+            raise ValueError(f"unknown config field: {path}")
+        setattr(obj, field, _coerce(getattr(obj, field), raw))
+    return cfg.validate()
+
+
+def load_config(path: Optional[str] = None, overrides: Sequence[str] = ()) -> ApexConfig:
+    """Load config: native JSON (sections matching dataclass fields) or
+    reference-format parameters.json, then CLI overrides."""
+    cfg = ApexConfig()
+    if path:
+        with open(path) as f:
+            data = json.load(f)
+        if any(s in data for s in ("env_conf", "Actor", "Learner", "Replay_Memory")):
+            cfg = from_reference_json(data)
+        else:
+            cfg = _from_native_json(data)
+    return apply_overrides(cfg, overrides)
+
+
+def _from_native_json(data: dict) -> ApexConfig:
+    cfg = ApexConfig()
+    sections = {
+        "env": EnvConfig, "actor": ActorConfig,
+        "learner": LearnerConfig, "replay": ReplayConfig,
+    }
+    for key, value in data.items():
+        if key in sections:
+            known = {f.name for f in dataclasses.fields(sections[key])}
+            unknown = set(value) - known
+            if unknown:
+                raise ValueError(f"unknown config keys in {key}: {sorted(unknown)}")
+            setattr(cfg, key, sections[key](**value))
+        elif key in ("network", "seed"):
+            setattr(cfg, key, data[key])
+        else:
+            raise ValueError(f"unknown top-level config entry: {key}")
+    return cfg.validate()
+
+
+def to_dict(cfg: ApexConfig) -> dict:
+    return dataclasses.asdict(cfg)

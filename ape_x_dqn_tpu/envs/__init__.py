@@ -1,0 +1,60 @@
+"""Environment layer: protocol, synthetic envs, Atari stack, vectorization.
+
+``make_env`` is the config-string factory the rest of the framework uses:
+  * ``"chain:N"``   — N-state ChainMDP (learning tests)
+  * ``"catch"``     — bsuite-style Catch (pixel learning tests)
+  * ``"random"`` / ``"random:HxWxC"`` — RandomFrameEnv (throughput benches)
+  * anything else   — the full Atari preprocessing stack via gymnasium
+    (reference env.py:3-4's ``gym.make``, plus the wrappers it lacked).
+"""
+
+from __future__ import annotations
+
+from ape_x_dqn_tpu.envs.atari import (
+    EpisodicLife,
+    FrameSkip,
+    FrameStack,
+    GymnasiumEnv,
+    ObsPreprocess,
+    RewardClip,
+    make_atari_env,
+    make_local_env,
+)
+from ape_x_dqn_tpu.envs.core import CatchEnv, ChainMDP, Env, RandomFrameEnv, StepResult
+from ape_x_dqn_tpu.envs.vector import SyncVectorEnv, VectorStep
+
+
+def make_env(spec: str, seed: int = 0, **atari_kwargs) -> Env:
+    """Build an env from a config string (see module docstring)."""
+    if spec.startswith("chain"):
+        n = int(spec.split(":")[1]) if ":" in spec else 10
+        return ChainMDP(n_states=n)
+    if spec == "catch":
+        return CatchEnv(seed=seed)
+    if spec.startswith("random"):
+        if ":" in spec:
+            dims = tuple(int(d) for d in spec.split(":")[1].split("x"))
+        else:
+            dims = (84, 84, 1)
+        return RandomFrameEnv(obs_shape=dims, seed=seed)
+    return make_atari_env(spec, **atari_kwargs)
+
+
+__all__ = [
+    "CatchEnv",
+    "ChainMDP",
+    "Env",
+    "EpisodicLife",
+    "FrameSkip",
+    "FrameStack",
+    "GymnasiumEnv",
+    "ObsPreprocess",
+    "RandomFrameEnv",
+    "RewardClip",
+    "StepResult",
+    "SyncVectorEnv",
+    "VectorStep",
+    "make_atari_env",
+    "make_env",
+    "make_local_env",
+]

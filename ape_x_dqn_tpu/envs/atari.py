@@ -1,0 +1,214 @@
+"""Gymnasium adapter + real Atari preprocessing.
+
+Capability parity with the reference's L0 plus the preprocessing it
+*intended*: the reference pipes raw gym frames through three lambdas — an
+RGB→gray dot product, an HWC→CHW reshape, and ``np.resize`` (byte
+repetition, NOT image rescaling; cv2 imported but unused — reference
+actor.py:9,117-119, SURVEY §2.8).  Here preprocessing is the standard DQN
+stack done correctly: luminance grayscale, cv2 area-interpolation resize to
+84×84, frame-skip with 2-frame max-pool, reward clipping, episodic life, and
+frame stacking — each an independent wrapper over the framework-native Env
+protocol.
+
+ALE is not installed in this image; ``make_atari_env`` raises a clear error
+if the gymnasium env can't be constructed, and every wrapper works over any
+protocol Env so the stack is fully testable with synthetic envs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ape_x_dqn_tpu.envs.core import Env, StepResult
+
+
+class GymnasiumEnv:
+    """Adapt a gymnasium env (5-tuple step API) to the framework protocol."""
+
+    def __init__(self, env):
+        self._env = env
+        self.num_actions = int(env.action_space.n)
+        obs_shape = env.observation_space.shape
+        self.observation_shape = tuple(obs_shape)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        obs, _info = self._env.reset(seed=seed)
+        return np.asarray(obs)
+
+    def step(self, action: int) -> StepResult:
+        obs, reward, terminated, truncated, _info = self._env.step(action)
+        return StepResult(np.asarray(obs), float(reward), bool(terminated), bool(truncated))
+
+    @property
+    def unwrapped(self):
+        return self._env
+
+
+def make_local_env(env_name: str) -> GymnasiumEnv:
+    """``gym.make`` passthrough — parity with reference env.py:3-4."""
+    import gymnasium
+
+    return GymnasiumEnv(gymnasium.make(env_name))
+
+
+class ObsPreprocess:
+    """Grayscale + resize to (height, width) uint8 — the intended capability
+    of reference actor.py:117-119 (84×84 grayscale, parameters.json:3),
+    implemented with a real cv2 area resize instead of ``np.resize``."""
+
+    def __init__(self, env: Env, height: int = 84, width: int = 84,
+                 grayscale: bool = True):
+        self._env = env
+        self._h, self._w = height, width
+        self._gray = grayscale
+        channels = 1 if grayscale else env.observation_shape[-1]
+        self.observation_shape = (height, width, channels)
+        self.num_actions = env.num_actions
+
+    def _proc(self, obs: np.ndarray) -> np.ndarray:
+        import cv2
+
+        if self._gray and obs.ndim == 3 and obs.shape[-1] == 3:
+            obs = cv2.cvtColor(obs, cv2.COLOR_RGB2GRAY)
+        if obs.shape[:2] != (self._h, self._w):
+            obs = cv2.resize(obs, (self._w, self._h), interpolation=cv2.INTER_AREA)
+        if obs.ndim == 2:
+            obs = obs[:, :, None]
+        return np.asarray(obs, np.uint8)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        return self._proc(self._env.reset(seed))
+
+    def step(self, action: int) -> StepResult:
+        r = self._env.step(action)
+        return r._replace(obs=self._proc(r.obs))
+
+
+class FrameSkip:
+    """Repeat each action ``skip`` times, max-pooling the last two raw frames
+    (the standard flicker fix); rewards accumulate over skipped frames."""
+
+    def __init__(self, env: Env, skip: int = 4):
+        if skip < 1:
+            raise ValueError("skip must be >= 1")
+        self._env = env
+        self._skip = skip
+        self.observation_shape = env.observation_shape
+        self.num_actions = env.num_actions
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        return self._env.reset(seed)
+
+    def step(self, action: int) -> StepResult:
+        total = 0.0
+        prev = obs = None
+        terminated = truncated = False
+        for _ in range(self._skip):
+            prev = obs
+            obs, reward, terminated, truncated = self._env.step(action)
+            total += reward
+            if terminated or truncated:
+                break
+        if prev is not None:
+            obs = np.maximum(obs, prev)
+        return StepResult(obs, total, terminated, truncated)
+
+
+class FrameStack:
+    """Stack the last ``k`` frames along the channel axis (NHWC)."""
+
+    def __init__(self, env: Env, k: int = 4):
+        self._env = env
+        self._k = k
+        h, w, c = env.observation_shape
+        self.observation_shape = (h, w, c * k)
+        self.num_actions = env.num_actions
+        self._frames = None
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        first = self._env.reset(seed)
+        self._frames = [first] * self._k
+        return np.concatenate(self._frames, axis=-1)
+
+    def step(self, action: int) -> StepResult:
+        r = self._env.step(action)
+        self._frames = self._frames[1:] + [r.obs]
+        return r._replace(obs=np.concatenate(self._frames, axis=-1))
+
+
+class RewardClip:
+    """Clip rewards to [-1, 1] (sign-preserving DQN standard)."""
+
+    def __init__(self, env: Env):
+        self._env = env
+        self.observation_shape = env.observation_shape
+        self.num_actions = env.num_actions
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        return self._env.reset(seed)
+
+    def step(self, action: int) -> StepResult:
+        r = self._env.step(action)
+        return r._replace(reward=float(np.clip(r.reward, -1.0, 1.0)))
+
+
+class EpisodicLife:
+    """Treat a life loss as a terminal for the learner (bootstrap cut) while
+    only truly resetting the emulator when the game ends.  Works with any
+    inner env exposing ``unwrapped.ale.lives()``; a no-op otherwise."""
+
+    def __init__(self, env):
+        self._env = env
+        self.observation_shape = env.observation_shape
+        self.num_actions = env.num_actions
+        self._lives = 0
+        self._real_done = True
+
+    def _ale_lives(self) -> int:
+        inner = getattr(self._env, "unwrapped", None)
+        ale = getattr(inner, "ale", None)
+        return int(ale.lives()) if ale is not None else 0
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if self._real_done:
+            obs = self._env.reset(seed)
+        else:
+            # Life lost mid-game: step a no-op to roll past the death frame.
+            obs = self._env.step(0).obs
+        self._lives = self._ale_lives()
+        return obs
+
+    def step(self, action: int) -> StepResult:
+        r = self._env.step(action)
+        self._real_done = r.terminated or r.truncated
+        lives = self._ale_lives()
+        terminated = r.terminated or (0 < lives < self._lives)
+        self._lives = lives
+        return r._replace(terminated=terminated)
+
+
+def make_atari_env(
+    env_name: str,
+    frame_skip: int = 4,
+    frame_stack: int = 1,
+    episodic_life: bool = True,
+    clip_rewards: bool = True,
+    height: int = 84,
+    width: int = 84,
+) -> Env:
+    """The full DQN Atari stack.  ``frame_stack=1`` is reference parity
+    (single grayscale frame, parameters.json:3); 4 is the Nature/Ape-X
+    setting."""
+    env = make_local_env(env_name)
+    if episodic_life:
+        env = EpisodicLife(env)
+    if frame_skip > 1:
+        env = FrameSkip(env, frame_skip)
+    env = ObsPreprocess(env, height, width)
+    if frame_stack > 1:
+        env = FrameStack(env, frame_stack)
+    if clip_rewards:
+        env = RewardClip(env)
+    return env

@@ -98,6 +98,28 @@ def build_nstep_transitions(
     )
 
 
+def nstep_returns_np(rewards: "np.ndarray", discounts: "np.ndarray", n: int):
+    """Numpy twin of :func:`nstep_returns` for host-side actor paths.
+
+    Actors live on the host thread next to the TPU learner; running their
+    n-step math through jnp would compile and dispatch tiny device programs
+    on the hot rollout path.  Same semantics, leading axis is time; extra
+    trailing axes (e.g. an actor axis [T, N]) broadcast through.
+    """
+    import numpy as np
+
+    T = rewards.shape[0]
+    if T < n:
+        raise ValueError(f"rollout length {T} < n-step horizon {n}")
+    out_len = T - n + 1
+    acc = np.zeros_like(rewards[:out_len], dtype=np.float32)
+    cumdisc = np.ones_like(discounts[:out_len], dtype=np.float32)
+    for k in range(n):
+        acc += cumdisc * rewards[k : k + out_len]
+        cumdisc = cumdisc * discounts[k : k + out_len]
+    return acc, cumdisc
+
+
 def nstep_returns_reference(rewards, discounts, n):
     """Slow pure-Python oracle for tests (mirrors the paper definition)."""
     T = len(rewards)

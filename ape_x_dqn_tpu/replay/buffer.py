@@ -46,7 +46,9 @@ class PrioritizedReplay:
       obs_shape: per-frame observation shape, e.g. (84, 84, 1).
       priority_exponent: α in p^α (reference parameters.json:29, default 0.6).
       obs_dtype: storage dtype for frames (uint8 default).
-      sum_tree_cls: injectable tree implementation (numpy or native C++).
+      sum_tree_cls: injectable tree implementation; default picks the native
+        C++ core (~10× the numpy tree's sample+update throughput at 2M slots)
+        when the toolchain allows, numpy otherwise.
     """
 
     def __init__(
@@ -55,8 +57,12 @@ class PrioritizedReplay:
         obs_shape,
         priority_exponent: float = 0.6,
         obs_dtype=np.uint8,
-        sum_tree_cls=SumTree,
+        sum_tree_cls=None,
     ):
+        if sum_tree_cls is None:
+            from ape_x_dqn_tpu.replay.native import default_sum_tree_cls
+
+            sum_tree_cls = default_sum_tree_cls()
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         self.capacity = int(capacity)

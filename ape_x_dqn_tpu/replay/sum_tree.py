@@ -32,6 +32,20 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def stratified_targets(
+    total: float, batch_size: int, rng: np.random.Generator
+) -> np.ndarray:
+    """One uniform draw per equal-mass stratum of [0, total), clamped below
+    total against round-off.  Shared by the numpy and native trees so their
+    stratified sampling stays bit-for-bit comparable."""
+    if total <= 0:
+        raise ValueError("cannot sample from an empty sum-tree")
+    bounds = total / batch_size
+    targets = (np.arange(batch_size) + rng.random(batch_size)) * bounds
+    np.clip(targets, 0.0, np.nextafter(total, 0.0), out=targets)
+    return targets
+
+
 class SumTree:
     """Vectorized sum-tree over ``capacity`` slots.
 
@@ -113,11 +127,4 @@ class SumTree:
     def sample_stratified(self, batch_size: int, rng: np.random.Generator) -> np.ndarray:
         """Stratified proportional sample: one draw per equal-mass stratum
         (lower variance than i.i.d. draws; standard PER practice)."""
-        total = self.total
-        if total <= 0:
-            raise ValueError("cannot sample from an empty sum-tree")
-        bounds = total / batch_size
-        targets = (np.arange(batch_size) + rng.random(batch_size)) * bounds
-        # Guard the top edge against round-off past total mass.
-        np.clip(targets, 0.0, np.nextafter(total, 0.0), out=targets)
-        return self.sample(targets)
+        return self.sample(stratified_targets(self.total, batch_size, rng))

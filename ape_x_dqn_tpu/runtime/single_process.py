@@ -1,0 +1,181 @@
+"""Single-process deterministic driver — the minimum end-to-end slice.
+
+One Python process, no threads: the actor fleet, replay, and learner are
+stepped round-robin with seeded PRNGs (SURVEY §7 build stage 3).  This is
+simultaneously:
+  * the integration test substrate (SURVEY §4 level 2: scripted env + actor +
+    replay + learner, asserting replay contents and loss finiteness);
+  * the race-free golden path the async runtime is checked against
+    (SURVEY §5 race detection: "deterministic single-thread mode");
+  * the smallest thing a user can run: ``SingleProcessDriver(cfg).run()``.
+
+Per iteration: ``actor.flush_every`` fleet steps (emitting one chunk per
+actor-fleet flush into replay), then — once replay holds
+``min_replay_mem_size`` transitions (reference learner.py:64-65) —
+``learner_steps_per_iter`` fused train steps with priority write-back and
+rate-capped parameter publication (fixing the reference's publish-every-step
+mismatch, learner.py:74 vs actor.py:189).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ape_x_dqn_tpu.actors import ActorFleet, EpisodeStat, LocalParamSource
+from ape_x_dqn_tpu.config import ApexConfig
+from ape_x_dqn_tpu.envs import make_env
+from ape_x_dqn_tpu.learner.train_step import (
+    build_train_step,
+    init_train_state,
+    make_optimizer,
+)
+from ape_x_dqn_tpu.models.dueling import build_network
+from ape_x_dqn_tpu.replay import PrioritizedReplay
+from ape_x_dqn_tpu.types import PrioritizedBatch
+
+
+class IterationResult(NamedTuple):
+    learner_step: int
+    actor_steps: int
+    replay_size: int
+    loss: float
+    mean_q: float
+    episodes: List[EpisodeStat]
+
+
+def beta_schedule(step: int, total_steps: int, beta0: float) -> float:
+    """Anneal the IS exponent β from β₀ to 1 over training (standard PER;
+    β₀ is the reference's dead ``importance_sampling_exponent`` key)."""
+    frac = min(1.0, step / max(1, total_steps))
+    return beta0 + (1.0 - beta0) * frac
+
+
+class SingleProcessDriver:
+    def __init__(self, cfg: ApexConfig, learner_steps_per_iter: int = 1):
+        cfg.validate()
+        self.cfg = cfg
+        self.learner_steps_per_iter = learner_steps_per_iter
+
+        probe = make_env(cfg.env.name, seed=cfg.seed)
+        obs_shape = probe.observation_shape
+        num_actions = probe.num_actions
+        if cfg.env.state_shape is not None and tuple(cfg.env.state_shape) != tuple(obs_shape):
+            raise ValueError(
+                f"config env.state_shape {cfg.env.state_shape} != actual {obs_shape}"
+            )
+        if cfg.env.action_dim is not None and cfg.env.action_dim != num_actions:
+            raise ValueError(
+                f"config env.action_dim {cfg.env.action_dim} != actual {num_actions}"
+            )
+        self.obs_shape = obs_shape
+        self.num_actions = num_actions
+
+        self.network = build_network(cfg.network, num_actions)
+        optimizer = make_optimizer(
+            cfg.learner.optimizer,
+            learning_rate=cfg.learner.learning_rate,
+            max_grad_norm=cfg.learner.max_grad_norm,
+        )
+        self._optimizer = optimizer
+        sample_obs = jnp.zeros((1, *obs_shape), jnp.uint8)
+        self.state = init_train_state(
+            self.network, optimizer, jax.random.PRNGKey(cfg.seed), sample_obs
+        )
+        self.train_step = build_train_step(
+            self.network,
+            optimizer,
+            loss_kind=cfg.learner.loss,
+            target_sync_freq=cfg.learner.q_target_sync_freq,
+        )
+        self.replay = PrioritizedReplay(
+            cfg.replay.capacity,
+            obs_shape,
+            priority_exponent=cfg.replay.priority_exponent,
+        )
+        env_fns = [
+            (lambda i=i: make_env(cfg.env.name, seed=cfg.seed + 1000 + i))
+            for i in range(cfg.actor.num_actors)
+        ]
+        self.fleet = ActorFleet(
+            env_fns,
+            self.network,
+            n_step=cfg.actor.num_steps,
+            gamma=cfg.actor.gamma,
+            epsilon=cfg.actor.epsilon,
+            epsilon_alpha=cfg.actor.alpha,
+            flush_every=cfg.actor.flush_every,
+            sync_every=cfg.actor.sync_every,
+            seed=cfg.seed,
+        )
+        self.param_source = LocalParamSource(self.state.params)
+        self.fleet.sync_params(self.param_source)
+        self._sample_rng = np.random.default_rng(cfg.seed + 7)
+        self.total_actor_steps = 0
+
+    @property
+    def learner_step(self) -> int:
+        return int(self.state.step)
+
+    def run_iteration(self) -> IterationResult:
+        cfg = self.cfg
+        chunks, episodes = self.fleet.collect(
+            cfg.actor.flush_every, param_source=self.param_source
+        )
+        for chunk in chunks:
+            self.replay.add(chunk.priorities, chunk.transitions)
+            self.total_actor_steps += chunk.actor_steps
+        loss = mean_q = float("nan")
+        if self.replay.size() >= cfg.learner.min_replay_mem_size:
+            for _ in range(self.learner_steps_per_iter):
+                beta = beta_schedule(
+                    self.learner_step, cfg.learner.total_steps, cfg.replay.is_exponent
+                )
+                batch = self.replay.sample(
+                    cfg.learner.replay_sample_size, beta=beta, rng=self._sample_rng
+                )
+                self.state, metrics = self.train_step(self.state, batch)
+                self.replay.update_priorities(
+                    np.asarray(batch.indices), np.asarray(metrics.priorities)
+                )
+                if self.learner_step % cfg.learner.publish_every == 0:
+                    self.param_source.publish(self.state.params)
+                loss = float(metrics.loss)
+                mean_q = float(metrics.mean_q)
+        return IterationResult(
+            learner_step=self.learner_step,
+            actor_steps=self.total_actor_steps,
+            replay_size=self.replay.size(),
+            loss=loss,
+            mean_q=mean_q,
+            episodes=episodes,
+        )
+
+    def run(
+        self,
+        learner_steps: Optional[int] = None,
+        max_iterations: Optional[int] = None,
+    ) -> List[IterationResult]:
+        """Run until ``learner_steps`` learner updates (default: config
+        total_steps), until each actor has taken ``actor.T`` env steps
+        (reference parameters.json:10 — fleet steps are per-actor steps in
+        lockstep), or until ``max_iterations`` — whichever comes first."""
+        target = learner_steps if learner_steps is not None else self.cfg.learner.total_steps
+        results = []
+        it = 0
+        while (
+            self.learner_step < target
+            and self.fleet.step_count < self.cfg.actor.T
+        ):
+            results.append(self.run_iteration())
+            it += 1
+            if max_iterations is not None and it >= max_iterations:
+                break
+        return results
+
+    def greedy_q_values(self, obs_batch: np.ndarray) -> np.ndarray:
+        """Online-net Q values for evaluation (host convenience)."""
+        return np.asarray(self.network.apply(self.state.params, jnp.asarray(obs_batch))[2])

@@ -1,0 +1,145 @@
+"""Env layer tests: synthetic envs, wrappers, vectorization (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.envs import (
+    CatchEnv,
+    ChainMDP,
+    FrameSkip,
+    FrameStack,
+    ObsPreprocess,
+    RandomFrameEnv,
+    RewardClip,
+    StepResult,
+    SyncVectorEnv,
+    make_env,
+)
+
+
+class TestChainMDP:
+    def test_optimal_rollout(self):
+        env = ChainMDP(n_states=5)
+        obs = env.reset()
+        assert obs.argmax() == 0
+        total, done = 0.0, False
+        for _ in range(4):
+            obs, r, done, trunc = env.step(1)
+            total += r
+        assert done and total == 1.0 and obs.argmax() == 4
+
+    def test_left_clamps_and_truncates(self):
+        env = ChainMDP(n_states=5, time_limit=3)
+        env.reset()
+        for i in range(3):
+            obs, r, term, trunc = env.step(0)
+        assert trunc and not term and obs.argmax() == 0
+
+
+class TestCatch:
+    def test_catch_and_miss(self):
+        env = CatchEnv(rows=5, cols=3, seed=0)
+        env.reset(seed=1)
+        ball_col = int(np.argwhere(env._obs()[0, :, 0])[0])
+        # Track the ball: move paddle toward ball_col each step.
+        done, reward = False, 0.0
+        while not done:
+            paddle = env._paddle
+            a = 1 + np.sign(ball_col - paddle)
+            _, reward, done, _ = env.step(int(a))
+        assert reward == 1.0
+
+    def test_obs_has_two_pixels(self):
+        env = CatchEnv()
+        obs = env.reset(seed=0)
+        assert (obs > 0).sum() in (1, 2)  # ball may overlap paddle column
+
+
+class FakePixelEnv:
+    """Deterministic raw RGB env for wrapper tests."""
+
+    observation_shape = (10, 8, 3)
+    num_actions = 2
+
+    def __init__(self):
+        self.t = 0
+
+    def reset(self, seed=None):
+        self.t = 0
+        return np.full(self.observation_shape, 10, np.uint8)
+
+    def step(self, action):
+        self.t += 1
+        obs = np.full(self.observation_shape, 10 * self.t % 250, np.uint8)
+        return StepResult(obs, 1.0, self.t >= 6, False)
+
+
+class TestWrappers:
+    def test_obs_preprocess_resizes_and_grays(self):
+        env = ObsPreprocess(FakePixelEnv(), height=4, width=4)
+        obs = env.reset()
+        assert obs.shape == (4, 4, 1) and obs.dtype == np.uint8
+
+    def test_frame_skip_accumulates_reward(self):
+        env = FrameSkip(FakePixelEnv(), skip=4)
+        env.reset()
+        r = env.step(0)
+        assert r.reward == 4.0
+
+    def test_frame_skip_stops_at_terminal(self):
+        env = FrameSkip(FakePixelEnv(), skip=4)
+        env.reset()
+        env.step(0)  # t=4
+        r = env.step(0)  # t=5,6 -> terminal at 6
+        assert r.terminated and r.reward == 2.0
+
+    def test_frame_stack(self):
+        env = FrameStack(ObsPreprocess(FakePixelEnv(), 4, 4), k=3)
+        obs = env.reset()
+        assert obs.shape == (4, 4, 3)
+        r = env.step(0)
+        # Newest frame is last channel; oldest two still the reset frame.
+        assert r.obs.shape == (4, 4, 3)
+
+    def test_reward_clip(self):
+        class BigReward(FakePixelEnv):
+            def step(self, action):
+                return super().step(action)._replace(reward=7.5)
+
+        env = RewardClip(BigReward())
+        env.reset()
+        assert env.step(0).reward == 1.0
+
+
+class TestVector:
+    def test_lockstep_and_autoreset(self):
+        envs = SyncVectorEnv([lambda: ChainMDP(4, time_limit=50)] * 3)
+        obs = envs.reset(seed=0)
+        assert obs.shape == (3, 4)
+        # All go right: terminal after 3 steps.
+        for t in range(3):
+            vs = envs.step(np.ones(3, np.int64))
+        assert vs.terminated.all()
+        # Final obs is the terminal state; reset_obs is the fresh start.
+        assert (vs.obs.argmax(-1) == 3).all()
+        assert (vs.reset_obs.argmax(-1) == 0).all()
+        assert np.allclose(vs.episode_return, 1.0)
+        assert (vs.episode_length == 3).all()
+
+    def test_episode_stats_nan_when_running(self):
+        envs = SyncVectorEnv([lambda: ChainMDP(10)] * 2)
+        envs.reset()
+        vs = envs.step(np.ones(2, np.int64))
+        assert np.isnan(vs.episode_return).all()
+
+    def test_heterogeneous_rejected(self):
+        with pytest.raises(ValueError):
+            SyncVectorEnv([lambda: ChainMDP(4), lambda: ChainMDP(5)])
+
+
+def test_make_env_specs():
+    assert isinstance(make_env("chain:7"), ChainMDP)
+    assert isinstance(make_env("catch"), CatchEnv)
+    env = make_env("random:16x16x1")
+    assert isinstance(env, RandomFrameEnv)
+    assert env.observation_shape == (16, 16, 1)
