@@ -176,7 +176,13 @@ class EpisodicLife:
             obs = self._env.reset(seed)
         else:
             # Life lost mid-game: step a no-op to roll past the death frame.
-            obs = self._env.step(0).obs
+            # If that very frame ends the game, fall through to a full reset
+            # so a "new episode" never starts on a game-over frame.
+            r = self._env.step(0)
+            obs = r.obs
+            if r.terminated or r.truncated:
+                self._real_done = True
+                obs = self._env.reset(seed)
         self._lives = self._ale_lives()
         return obs
 

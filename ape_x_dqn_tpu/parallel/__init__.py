@@ -1,0 +1,24 @@
+"""Parallelism layer: device mesh, shardings, data-parallel learner step."""
+
+from ape_x_dqn_tpu.parallel.dp import build_sharded_train_step, place_batch
+from ape_x_dqn_tpu.parallel.mesh import (
+    batch_sharding,
+    infer_param_sharding,
+    make_mesh,
+    place_state,
+    replicated,
+    shard_train_state,
+    tree_batch_sharding,
+)
+
+__all__ = [
+    "batch_sharding",
+    "build_sharded_train_step",
+    "infer_param_sharding",
+    "make_mesh",
+    "place_batch",
+    "place_state",
+    "replicated",
+    "shard_train_state",
+    "tree_batch_sharding",
+]

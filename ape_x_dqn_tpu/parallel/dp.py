@@ -1,0 +1,81 @@
+"""The sharded learner step: one XLA program over the whole mesh.
+
+``build_sharded_train_step`` takes the same fused train step the single-chip
+learner uses (learner/train_step.py — double-Q target, loss, grads, optimizer,
+target sync, priorities in one program) and jits it with mesh shardings:
+
+  * TrainState replicated (or model-axis sharded for wide kernels —
+    parallel/mesh.py);
+  * the replay batch sharded over ``data`` on its leading axis;
+  * XLA's SPMD partitioner turns the batch-mean loss gradient into partial
+    per-shard reductions + an **all-reduce over ICI** — the TPU-native
+    replacement for the learner data-parallelism the reference entirely
+    lacks (single CPU learner process, SURVEY §2 parallelism checklist);
+  * per-transition priorities come back sharded over ``data``; the host
+    gathers them when writing to the replay (a [B] float vector — trivial
+    DCN/PCIe traffic).
+
+This is BASELINE.md config 4 ("Data-parallel learner on v4-8: pjit grad
+all-reduce over ICI") as a library function.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ape_x_dqn_tpu.learner.train_step import StepMetrics, build_train_step
+from ape_x_dqn_tpu.parallel.mesh import (
+    batch_sharding,
+    place_state,
+    replicated,
+    shard_train_state,
+    tree_batch_sharding,
+)
+from ape_x_dqn_tpu.types import PrioritizedBatch, TrainState
+
+
+def build_sharded_train_step(
+    network,
+    optimizer,
+    mesh: Mesh,
+    state_example: TrainState,
+    batch_example: PrioritizedBatch,
+    **train_kwargs,
+) -> Tuple[Callable, TrainState]:
+    """Build the mesh-sharded fused step and place the state on the mesh.
+
+    Returns ``(step_fn, sharded_state)``.  ``step_fn(state, batch) ->
+    (state, metrics)`` donates the state; callers must feed batches placed
+    with :func:`place_batch` (or any committed layout matching the batch
+    sharding — jit moves uncommitted host arrays automatically).
+    """
+    base_step = build_train_step(network, optimizer, jit=False, **train_kwargs)
+
+    state_sh = shard_train_state(state_example, mesh)
+    batch_sh = tree_batch_sharding(batch_example, mesh)
+    rep = replicated(mesh)
+    metrics_sh = StepMetrics(
+        loss=rep,
+        mean_abs_td=rep,
+        max_abs_td=rep,
+        # Priorities stay data-sharded: each shard computed its own rows.
+        priorities=NamedSharding(mesh, P("data")),
+        mean_q=rep,
+    )
+    step_fn = jax.jit(
+        base_step,
+        in_shardings=(state_sh, batch_sh),
+        out_shardings=(state_sh, metrics_sh),
+        donate_argnums=(0,),
+    )
+    sharded_state = place_state(state_example, state_sh)
+    return step_fn, sharded_state
+
+
+def place_batch(batch: PrioritizedBatch, mesh: Mesh) -> PrioritizedBatch:
+    """Shard a host batch over the mesh's data axis (leading dim)."""
+    sh = batch_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)

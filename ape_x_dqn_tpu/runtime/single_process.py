@@ -60,13 +60,25 @@ class SingleProcessDriver:
         self.cfg = cfg
         self.learner_steps_per_iter = learner_steps_per_iter
 
-        probe = make_env(cfg.env.name, seed=cfg.seed)
+        self._env_kwargs = dict(
+            frame_skip=cfg.env.frame_skip,
+            frame_stack=cfg.env.frame_stack,
+            episodic_life=cfg.env.episodic_life,
+            clip_rewards=cfg.env.clip_rewards,
+        )
+        probe = make_env(cfg.env.name, seed=cfg.seed, **self._env_kwargs)
         obs_shape = probe.observation_shape
         num_actions = probe.num_actions
-        if cfg.env.state_shape is not None and tuple(cfg.env.state_shape) != tuple(obs_shape):
-            raise ValueError(
-                f"config env.state_shape {cfg.env.state_shape} != actual {obs_shape}"
-            )
+        if cfg.env.state_shape is not None:
+            want = tuple(cfg.env.state_shape)
+            got = tuple(obs_shape)
+            # Accept the reference's CHW spelling ([1, 84, 84],
+            # parameters.json:3) for our HWC layout.
+            chw_of_got = (got[-1], *got[:-1]) if len(got) == 3 else got
+            if want != got and want != chw_of_got:
+                raise ValueError(
+                    f"config env.state_shape {want} != actual {got}"
+                )
         if cfg.env.action_dim is not None and cfg.env.action_dim != num_actions:
             raise ValueError(
                 f"config env.action_dim {cfg.env.action_dim} != actual {num_actions}"
@@ -85,6 +97,28 @@ class SingleProcessDriver:
         self.state = init_train_state(
             self.network, optimizer, jax.random.PRNGKey(cfg.seed), sample_obs
         )
+        self._learner_step = 0
+        if cfg.learner.restore_from:
+            # Resume gate mirroring the reference's load_saved_state
+            # (learner.py:18-23) — but restoring the FULL train state, with
+            # the same missing-file fallback to scratch.  restore_from=True
+            # (the reference's boolean spelling) means "my checkpoint_dir".
+            from ape_x_dqn_tpu.utils.checkpoint import restore_checkpoint
+
+            restore_path = (
+                cfg.learner.checkpoint_dir
+                if cfg.learner.restore_from is True
+                else str(cfg.learner.restore_from)
+            )
+            try:
+                self.state, step = restore_checkpoint(restore_path, self.state)
+                self._learner_step = step
+                print(f"restored checkpoint at step {step}")
+            except FileNotFoundError:
+                print(
+                    f"WARNING: no checkpoint at {restore_path}; "
+                    "starting from scratch"
+                )
         self.train_step = build_train_step(
             self.network,
             optimizer,
@@ -97,7 +131,9 @@ class SingleProcessDriver:
             priority_exponent=cfg.replay.priority_exponent,
         )
         env_fns = [
-            (lambda i=i: make_env(cfg.env.name, seed=cfg.seed + 1000 + i))
+            (lambda i=i: make_env(
+                cfg.env.name, seed=cfg.seed + 1000 + i, **self._env_kwargs
+            ))
             for i in range(cfg.actor.num_actors)
         ]
         self.fleet = ActorFleet(
@@ -118,7 +154,9 @@ class SingleProcessDriver:
 
     @property
     def learner_step(self) -> int:
-        return int(self.state.step)
+        # Host-side mirror of state.step: reading the device scalar would
+        # block on the in-flight train step three times per update.
+        return self._learner_step
 
     def run_iteration(self) -> IterationResult:
         cfg = self.cfg
@@ -138,11 +176,19 @@ class SingleProcessDriver:
                     cfg.learner.replay_sample_size, beta=beta, rng=self._sample_rng
                 )
                 self.state, metrics = self.train_step(self.state, batch)
+                self._learner_step += 1
                 self.replay.update_priorities(
                     np.asarray(batch.indices), np.asarray(metrics.priorities)
                 )
                 if self.learner_step % cfg.learner.publish_every == 0:
                     self.param_source.publish(self.state.params)
+                if (
+                    cfg.learner.checkpoint_every
+                    and self.learner_step % cfg.learner.checkpoint_every == 0
+                ):
+                    from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
+
+                    save_checkpoint(cfg.learner.checkpoint_dir, self.state)
                 loss = float(metrics.loss)
                 mean_q = float(metrics.mean_q)
         return IterationResult(

@@ -1,0 +1,130 @@
+"""Checkpoint save + resume — the full train state, symmetrically.
+
+The reference can only *load*: learner.py:18-23 restores the online net from
+a ``torch.load`` if ``load_saved_state`` is set, nothing ever saves, and the
+optimizer / target net / step / replay are silently dropped (SURVEY §5
+checkpoint subsystem).  Here both directions cover the whole TrainState
+pytree (params, target params, optimizer state, step, PRNG key) via orbax —
+the TPU-native checkpointer (async-capable, multi-host-aware, sharding-
+preserving) — plus an optional replay-buffer snapshot (numpy .npz; frames
+are uint8 so a snapshot is exactly the buffer's RAM footprint).
+
+Layout under ``<dir>/``:
+    step_<N>/state/   — orbax pytree checkpoint of the TrainState
+    step_<N>/replay.npz — optional replay snapshot
+``latest_step`` finds the newest complete checkpoint; partial writes are
+ignored because orbax commits atomically (tmp dir + rename).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from ape_x_dqn_tpu.types import TrainState
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _step_dir(root: str, step: int) -> str:
+    return os.path.join(os.path.abspath(root), f"step_{step}")
+
+
+def latest_step(root: str) -> Optional[int]:
+    """Newest step with a committed state checkpoint, or None."""
+    if not os.path.isdir(root):
+        return None
+    steps = []
+    for name in os.listdir(root):
+        m = _STEP_RE.match(name)
+        if m and os.path.isdir(os.path.join(root, name, "state")):
+            steps.append(int(m.group(1)))
+    return max(steps) if steps else None
+
+
+def save_checkpoint(
+    root: str,
+    state: TrainState,
+    replay=None,
+    keep: int = 3,
+) -> str:
+    """Save the train state (and optionally the replay) at its step count.
+
+    Retains the newest ``keep`` checkpoints, pruning older ones.
+    """
+    step = int(jax.device_get(state.step))
+    path = _step_dir(root, step)
+    os.makedirs(path, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(
+            os.path.join(path, "state"),
+            jax.device_get(state),
+            force=True,
+        )
+    if replay is not None:
+        np.savez(os.path.join(path, "replay.npz"), **replay.state_dict())
+    if keep is not None:
+        _prune(root, keep)
+    return path
+
+
+def restore_checkpoint(
+    root_or_path: str,
+    state_template: TrainState,
+    replay=None,
+) -> Tuple[TrainState, int]:
+    """Restore the newest (or an explicit ``step_N``) checkpoint.
+
+    ``state_template`` supplies structure/dtypes/shardings (an initialized
+    TrainState); returns (state, step).  If ``replay`` is given and the
+    checkpoint has a replay snapshot, the buffer is restored in place.
+
+    Missing checkpoints raise FileNotFoundError — the caller decides whether
+    that means "start from scratch" (the reference's fallback,
+    learner.py:22-23) or a hard error.
+    """
+    root_or_path = os.path.abspath(root_or_path)
+    if _STEP_RE.match(os.path.basename(root_or_path)):
+        path = root_or_path
+    else:
+        step = latest_step(root_or_path)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {root_or_path}")
+        path = _step_dir(root_or_path, step)
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(
+            os.path.join(path, "state"), jax.device_get(state_template)
+        )
+    # Re-place each leaf per the template's layout (preserves mesh shardings
+    # when restoring into a pjit'd learner).
+    state = jax.tree_util.tree_map(
+        lambda t, x: jax.device_put(
+            x, t.sharding if isinstance(t, jax.Array) else None
+        ),
+        state_template,
+        state,
+    )
+    replay_file = os.path.join(path, "replay.npz")
+    if replay is not None and os.path.exists(replay_file):
+        with np.load(replay_file) as z:
+            replay.load_state_dict({k: z[k] for k in z.files})
+    return state, int(jax.device_get(state.step))
+
+
+def _prune(root: str, keep: int) -> None:
+    import shutil
+
+    # Only committed checkpoints (a state/ subdir exists) count toward
+    # `keep`; junk dirs from crashed saves must not displace real ones.
+    steps = sorted(
+        int(m.group(1))
+        for m in (_STEP_RE.match(n) for n in os.listdir(root))
+        if m and os.path.isdir(os.path.join(root, m.group(0), "state"))
+    )
+    for s in steps[:-keep] if keep > 0 else []:
+        shutil.rmtree(_step_dir(root, s), ignore_errors=True)

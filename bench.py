@@ -1,0 +1,91 @@
+"""Benchmark: fused learner step throughput on the real chip.
+
+Prints ONE JSON line:
+    {"metric": "learner_steps_per_sec", "value": N, "unit": "steps/s",
+     "vs_baseline": R}
+
+The metric is gradient steps/sec of the fully-fused train step (double-Q
+target, loss, grads, RMSProp, target-sync, per-transition priorities in one
+XLA program) on the flagship dueling conv net at the reference workload
+scale (batch 32, 84x84x1 uint8 frames — reference parameters.json:3,23).
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is the
+fraction of the north-star target rate prorated to this chip count:
+50_000 steps/s on a v4-8 (4 chips) → 12_500 steps/s per chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NORTH_STAR_PER_CHIP = 50_000 / 4.0
+
+
+def main() -> None:
+    from ape_x_dqn_tpu.learner.train_step import (
+        build_train_step,
+        init_train_state,
+        make_optimizer,
+    )
+    from ape_x_dqn_tpu.models.dueling import build_network
+    from ape_x_dqn_tpu.types import NStepTransition, PrioritizedBatch
+
+    B, obs_shape, A = 32, (84, 84, 1), 4
+    net = build_network("conv", A)
+    opt = make_optimizer("rmsprop")
+    state = init_train_state(
+        net, opt, jax.random.PRNGKey(0), jnp.zeros((1, *obs_shape), jnp.uint8)
+    )
+    step = build_train_step(net, opt)
+
+    rng = np.random.default_rng(0)
+    n_batches = 8
+    batches = [
+        jax.device_put(
+            PrioritizedBatch(
+                transition=NStepTransition(
+                    obs=rng.integers(0, 255, (B, *obs_shape), dtype=np.uint8),
+                    action=rng.integers(0, A, (B,), dtype=np.int32),
+                    reward=rng.normal(size=(B,)).astype(np.float32),
+                    discount=np.full((B,), 0.97, np.float32),
+                    next_obs=rng.integers(0, 255, (B, *obs_shape), dtype=np.uint8),
+                ),
+                indices=np.arange(B, dtype=np.int32),
+                is_weights=np.ones((B,), np.float32),
+            )
+        )
+        for _ in range(n_batches)
+    ]
+
+    # Warmup: compile + a few steps.
+    for i in range(3):
+        state, metrics = step(state, batches[i % n_batches])
+    jax.block_until_ready(metrics.loss)
+
+    steps = 600
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step(state, batches[i % n_batches])
+    jax.block_until_ready(metrics.loss)
+    dt = time.perf_counter() - t0
+
+    rate = steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "learner_steps_per_sec",
+                "value": round(rate, 1),
+                "unit": "steps/s",
+                "vs_baseline": round(rate / NORTH_STAR_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
