@@ -1,0 +1,6 @@
+"""``python -m ape_x_dqn_tpu`` → the CLI trainer (train.py)."""
+
+from ape_x_dqn_tpu.train import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,17 @@
+"""Runtimes: deterministic single-process driver + async pipeline."""
+
+from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
+from ape_x_dqn_tpu.runtime.components import Components, build_components
+from ape_x_dqn_tpu.runtime.infeed import PrefetchQueue
+from ape_x_dqn_tpu.runtime.param_store import ParamStore
+from ape_x_dqn_tpu.runtime.single_process import SingleProcessDriver, beta_schedule
+
+__all__ = [
+    "AsyncPipeline",
+    "Components",
+    "ParamStore",
+    "PrefetchQueue",
+    "SingleProcessDriver",
+    "beta_schedule",
+    "build_components",
+]

@@ -1,0 +1,252 @@
+"""The async Ape-X pipeline: actors ∥ replay ∥ learner on one host.
+
+This is the reference's architectural idea — three concurrently-running
+stages decoupled by the replay (reference main.py:46-58) — rebuilt on the
+TPU-native transport stack instead of manager-proxy RPC:
+
+  actor thread(s) ──chunks──▶ PrioritizedReplay ◀──sample── feeder thread
+        ▲                                                        │ device_put
+        └──── ParamStore (versioned snapshots) ◀── learner ◀── PrefetchQueue
+
+  * **Actor stage**: one thread per fleet (each fleet is already a batched
+    vector of actors — one jitted forward per fleet step).  Exceptions
+    respawn the fleet (actors are stateless modulo ε/seed — SURVEY §5
+    failure detection: "recovery is respawn + param re-pull"); heartbeats
+    are exported as metrics.
+  * **Replay stage**: the buffer's own lock discipline (batched ops only);
+    no drain process — writers call straight into the ring, which is the
+    reference's queue+drain collapsed into one bounded structure with
+    backpressure by construction (the reference's manager queue is
+    unbounded — SURVEY §3.4).
+  * **Learner stage**: runs on the caller thread.  Batches arrive staged on
+    device by the PrefetchQueue (host sample + transfer hidden behind the
+    running step); priority write-back is deferred by one step so the host
+    never blocks on the in-flight step's outputs; params publish to the
+    store at the capped rate.
+
+Stop/join semantics: ``run()`` drives the learner to a step target, then
+signals actors and joins them (the reference crashes at exactly this point —
+main.py:61 joins a list).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ape_x_dqn_tpu.actors import EpisodeStat
+from ape_x_dqn_tpu.config import ApexConfig
+from ape_x_dqn_tpu.runtime.components import build_components
+from ape_x_dqn_tpu.runtime.infeed import PrefetchQueue
+from ape_x_dqn_tpu.runtime.param_store import ParamStore
+from ape_x_dqn_tpu.utils.metrics import MetricLogger, RateCounter
+
+
+class _ActorWorker:
+    """Supervised actor-fleet thread with respawn-on-crash."""
+
+    def __init__(self, comps, store: ParamStore, stop: threading.Event,
+                 logger: MetricLogger, fps: RateCounter,
+                 max_restarts: int = 3, quantum: Optional[int] = None):
+        self._comps = comps
+        self._store = store
+        self._stop = stop
+        self._logger = logger
+        self._fps = fps
+        self._max_restarts = max_restarts
+        self._quantum = quantum or comps.cfg.actor.flush_every
+        self.restarts = 0
+        self.finished = False  # clean exit (actor.T reached), not a crash
+        self.heartbeat = time.monotonic()
+        self.episodes: List[EpisodeStat] = []
+        self._ep_lock = threading.Lock()
+        self.actor_steps = 0
+        self.error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._supervise, name="actor-fleet", daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+
+    def join(self, timeout: float = 30.0):
+        self._thread.join(timeout)
+
+    def drain_episodes(self) -> List[EpisodeStat]:
+        with self._ep_lock:
+            out, self.episodes = self.episodes, []
+        return out
+
+    def _supervise(self):
+        while not self._stop.is_set():
+            try:
+                fleet = self._comps.make_fleet(seed_offset=self.restarts)
+                fleet.sync_params(self._store)
+                self._run_fleet(fleet)
+                # Distinguish "actor.T exhausted" from "told to stop".
+                self.finished = not self._stop.is_set()
+                return  # clean stop
+            except Exception as e:
+                self.restarts += 1
+                self._logger.log("actor/restarts", self.restarts)
+                if self.restarts > self._max_restarts:
+                    self.error = e
+                    self._stop.set()
+                    return
+                time.sleep(0.1)
+
+    def _run_fleet(self, fleet):
+        max_steps = self._comps.cfg.actor.T
+        while not self._stop.is_set() and fleet.step_count < max_steps:
+            chunks, stats = fleet.collect(self._quantum, param_source=self._store)
+            for chunk in chunks:
+                self._comps.replay.add(chunk.priorities, chunk.transitions)
+                self.actor_steps += chunk.actor_steps
+                self._fps.add(chunk.actor_steps)
+            if stats:
+                with self._ep_lock:
+                    self.episodes.extend(stats)
+            self.heartbeat = time.monotonic()
+
+
+class AsyncPipeline:
+    """One-host async runtime.  ``run()`` blocks the caller as the learner."""
+
+    def __init__(
+        self,
+        cfg: ApexConfig,
+        logger: Optional[MetricLogger] = None,
+        log_every: int = 500,
+        prefetch_depth: int = 2,
+        max_actor_restarts: int = 3,
+    ):
+        self.comps = build_components(cfg)
+        self.cfg = self.comps.cfg
+        self.logger = logger or MetricLogger()
+        self.log_every = log_every
+        self.train_step = self.comps.make_train_step()
+        self.store = ParamStore(self.comps.state.params)
+        self.stop_event = threading.Event()
+        self._fps = RateCounter()
+        self._steps_rate = RateCounter()
+        self._prefetch_depth = prefetch_depth
+        self.worker = _ActorWorker(
+            self.comps, self.store, self.stop_event, self.logger, self._fps,
+            max_restarts=max_actor_restarts,
+        )
+        self._learner_step = self.comps.learner_step
+        self._sample = self.comps.make_sampler(lambda: self._learner_step)
+        self.episode_returns: List[float] = []
+
+    @property
+    def learner_step(self) -> int:
+        return self._learner_step
+
+    def _wait_for_warmup(self, timeout: float):
+        """Block until replay holds min_replay_mem_size transitions
+        (reference learner.py:64-65's poll loop)."""
+        deadline = time.monotonic() + timeout
+        while self.comps.replay.size() < self.cfg.learner.min_replay_mem_size:
+            if self.stop_event.is_set():
+                raise RuntimeError("actors stopped during warmup") from self.worker.error
+            if self.worker.finished:
+                raise RuntimeError(
+                    f"actors exhausted actor.T={self.cfg.actor.T} env steps "
+                    f"with replay at {self.comps.replay.size()} / "
+                    f"{self.cfg.learner.min_replay_mem_size} — raise actor.T "
+                    "or lower learner.min_replay_mem_size"
+                )
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"replay warmup stalled at {self.comps.replay.size()} / "
+                    f"{self.cfg.learner.min_replay_mem_size}"
+                )
+            time.sleep(0.05)
+
+    def run(
+        self,
+        learner_steps: Optional[int] = None,
+        warmup_timeout: float = 600.0,
+    ) -> dict:
+        cfg = self.cfg
+        target = learner_steps if learner_steps is not None else cfg.learner.total_steps
+        self.worker.start()
+        try:
+            self._wait_for_warmup(warmup_timeout)
+            with PrefetchQueue(
+                self._sample,
+                place_fn=self._place,
+                depth=self._prefetch_depth,
+            ) as queue:
+                pending = None  # (indices, device priorities) of previous step
+                state = self.comps.state
+                while self._learner_step < target and not self.stop_event.is_set():
+                    host_indices, batch = queue.get()
+                    state, metrics = self.train_step(state, batch)
+                    # Keep the live state visible on self so a mid-run
+                    # exception never strands an advanced step counter with
+                    # stale params (a ref assignment, no device sync).
+                    self.comps.state = state
+                    self._learner_step += 1
+                    self._steps_rate.add(1)
+                    # Deferred priority write-back: commit the PREVIOUS
+                    # step's priorities now (its device work has finished
+                    # behind the current dispatch), never blocking on the
+                    # step just launched.
+                    if pending is not None:
+                        self.comps.replay.update_priorities(
+                            pending[0], np.asarray(pending[1])
+                        )
+                    pending = (host_indices, metrics.priorities)
+                    if self._learner_step % cfg.learner.publish_every == 0:
+                        self.store.publish(state.params)
+                    if (
+                        cfg.learner.checkpoint_every
+                        and self._learner_step % cfg.learner.checkpoint_every == 0
+                    ):
+                        from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
+
+                        save_checkpoint(cfg.learner.checkpoint_dir, state)
+                    if self._learner_step % self.log_every == 0:
+                        self._emit(metrics)
+                if pending is not None:
+                    self.comps.replay.update_priorities(
+                        pending[0], np.asarray(pending[1])
+                    )
+        finally:
+            self.stop_event.set()
+            self.worker.join()
+        if self.worker.error is not None:
+            raise RuntimeError("actor worker died") from self.worker.error
+        return self._emit(final=True)
+
+    def _place(self, host_batch):
+        """Stage a host batch on device, keeping host indices for the
+        deferred priority write-back."""
+        import jax
+
+        return np.asarray(host_batch.indices), jax.device_put(host_batch)
+
+    def _emit(self, metrics=None, final: bool = False) -> dict:
+        eps = self.worker.drain_episodes()
+        for e in eps:
+            self.episode_returns.append(e.episode_return)
+            self.logger.log("episode/return", e.episode_return)
+            self.logger.log("episode/length", e.episode_length)
+        if metrics is not None:
+            self.logger.log("learner/loss", float(metrics.loss))
+            self.logger.log("learner/mean_q", float(metrics.mean_q))
+        return self.logger.emit(
+            step=self._learner_step,
+            actor_steps=self.worker.actor_steps,
+            replay_size=self.comps.replay.size(),
+            steps_per_sec=round(self._steps_rate.rate(), 1),
+            actor_fps=round(self._fps.rate(), 1),
+            param_version=self.store.version,
+            actor_restarts=self.worker.restarts,
+            actor_heartbeat_age=round(time.monotonic() - self.worker.heartbeat, 3),
+            final=final,
+        )

@@ -1,0 +1,159 @@
+"""Shared component construction: config → (network, state, replay, fleet).
+
+Both runtimes — the deterministic single-process driver and the async
+pipeline — wire the same objects; this is the one place config becomes
+components (the analogue of reference main.py:28-58's inline wiring, as a
+reusable function instead of a ``__main__`` block).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ape_x_dqn_tpu.actors import ActorFleet
+from ape_x_dqn_tpu.config import ApexConfig
+from ape_x_dqn_tpu.envs import make_env
+from ape_x_dqn_tpu.learner.train_step import init_train_state, make_optimizer
+from ape_x_dqn_tpu.models.dueling import build_network
+from ape_x_dqn_tpu.replay import PrioritizedReplay
+from ape_x_dqn_tpu.types import TrainState
+
+
+@dataclasses.dataclass
+class Components:
+    cfg: ApexConfig
+    obs_shape: tuple
+    num_actions: int
+    network: object
+    optimizer: object
+    state: TrainState
+    learner_step: int          # host-side mirror (== restored step or 0)
+    replay: PrioritizedReplay
+    env_fns: List[Callable]
+
+    def make_train_step(self):
+        """The fused learner step with this config's loss/target-sync knobs —
+        one construction point for both runtimes."""
+        from ape_x_dqn_tpu.learner.train_step import build_train_step
+
+        return build_train_step(
+            self.network,
+            self.optimizer,
+            loss_kind=self.cfg.learner.loss,
+            target_sync_freq=self.cfg.learner.q_target_sync_freq,
+        )
+
+    def make_sampler(self, learner_step_fn: Callable[[], int]):
+        """Replay sampler with the β-annealed IS schedule; ``learner_step_fn``
+        supplies the current step for annealing."""
+        import numpy as np
+
+        from ape_x_dqn_tpu.runtime.single_process import beta_schedule
+
+        rng = np.random.default_rng(self.cfg.seed + 7)
+        cfg = self.cfg
+
+        def sample():
+            beta = beta_schedule(
+                learner_step_fn(), cfg.learner.total_steps, cfg.replay.is_exponent
+            )
+            return self.replay.sample(
+                cfg.learner.replay_sample_size, beta=beta, rng=rng
+            )
+
+        return sample
+
+    def make_fleet(self, seed_offset: int = 0) -> ActorFleet:
+        """Build a fresh actor fleet (supervisor restarts call this again —
+        actors are stateless modulo ε/seed, so recovery is respawn +
+        param re-pull, SURVEY §5 failure detection)."""
+        cfg = self.cfg
+        return ActorFleet(
+            self.env_fns,
+            self.network,
+            n_step=cfg.actor.num_steps,
+            gamma=cfg.actor.gamma,
+            epsilon=cfg.actor.epsilon,
+            epsilon_alpha=cfg.actor.alpha,
+            flush_every=cfg.actor.flush_every,
+            sync_every=cfg.actor.sync_every,
+            seed=cfg.seed + seed_offset,
+        )
+
+
+def build_components(cfg: ApexConfig) -> Components:
+    cfg.validate()
+    env_kwargs = dict(
+        frame_skip=cfg.env.frame_skip,
+        frame_stack=cfg.env.frame_stack,
+        episodic_life=cfg.env.episodic_life,
+        clip_rewards=cfg.env.clip_rewards,
+    )
+    probe = make_env(cfg.env.name, seed=cfg.seed, **env_kwargs)
+    obs_shape = probe.observation_shape
+    num_actions = probe.num_actions
+    if cfg.env.state_shape is not None:
+        want, got = tuple(cfg.env.state_shape), tuple(obs_shape)
+        # Accept the reference's CHW spelling ([1, 84, 84], parameters.json:3)
+        # for our HWC layout.
+        chw_of_got = (got[-1], *got[:-1]) if len(got) == 3 else got
+        if want != got and want != chw_of_got:
+            raise ValueError(f"config env.state_shape {want} != actual {got}")
+    if cfg.env.action_dim is not None and cfg.env.action_dim != num_actions:
+        raise ValueError(
+            f"config env.action_dim {cfg.env.action_dim} != actual {num_actions}"
+        )
+
+    network = build_network(cfg.network, num_actions)
+    optimizer = make_optimizer(
+        cfg.learner.optimizer,
+        learning_rate=cfg.learner.learning_rate,
+        max_grad_norm=cfg.learner.max_grad_norm,
+    )
+    state = init_train_state(
+        network, optimizer, jax.random.PRNGKey(cfg.seed),
+        jnp.zeros((1, *obs_shape), jnp.uint8),
+    )
+    learner_step = 0
+    if cfg.learner.restore_from:
+        # Resume gate mirroring the reference's load_saved_state
+        # (learner.py:18-23) — restoring the FULL train state, with the same
+        # missing-file fallback to scratch.  True means "my checkpoint_dir".
+        from ape_x_dqn_tpu.utils.checkpoint import restore_checkpoint
+
+        restore_path = (
+            cfg.learner.checkpoint_dir
+            if cfg.learner.restore_from is True
+            else str(cfg.learner.restore_from)
+        )
+        try:
+            state, learner_step = restore_checkpoint(restore_path, state)
+            print(f"restored checkpoint at step {learner_step}")
+        except FileNotFoundError:
+            print(
+                f"WARNING: no checkpoint at {restore_path}; starting from scratch"
+            )
+
+    replay = PrioritizedReplay(
+        cfg.replay.capacity, obs_shape,
+        priority_exponent=cfg.replay.priority_exponent,
+    )
+    env_fns = [
+        (lambda i=i: make_env(cfg.env.name, seed=cfg.seed + 1000 + i, **env_kwargs))
+        for i in range(cfg.actor.num_actors)
+    ]
+    return Components(
+        cfg=cfg,
+        obs_shape=obs_shape,
+        num_actions=num_actions,
+        network=network,
+        optimizer=optimizer,
+        state=state,
+        learner_step=learner_step,
+        replay=replay,
+        env_fns=env_fns,
+    )

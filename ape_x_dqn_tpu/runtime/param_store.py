@@ -1,0 +1,71 @@
+"""Versioned parameter store — the learner→actor broadcast channel.
+
+The reference broadcasts via a ``multiprocessing.Manager().dict()`` holding
+one key: the learner pickles its full ``state_dict`` through the manager
+server on EVERY update (reference learner.py:74) while actors deserialize it
+every 500 steps (actor.py:189-191) — a push-always/pull-rarely mismatch with
+a serialization tax on the learner's hot loop (SURVEY §2 backend entry).
+
+Here the channel is an atomic versioned snapshot in host RAM:
+  * the learner publishes at a *capped rate* (learner-side ``publish_every``),
+    paying one device→host transfer per publish, nothing per step;
+  * readers poll ``get(have_version)`` and pay only when the version moved —
+    the whole-value-atomicity discipline the reference relied on, made
+    explicit (SURVEY §5 race detection);
+  * ``staleness`` (publishes missed by the slowest reader) is a first-class
+    metric;
+  * over DCN, multi-host actor fleets mount the same interface backed by a
+    fetch of the snapshot bytes (utils/serialization) — the store is the
+    single seam between intra-host and cross-host param distribution.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Tuple
+
+import jax
+
+
+class ParamStore:
+    """Thread-safe versioned parameter snapshots (host numpy pytrees)."""
+
+    def __init__(self, params: Optional[Any] = None):
+        self._lock = threading.Lock()
+        self._params = jax.device_get(params) if params is not None else None
+        # Initial params (if any) are version 0; each publish bumps by 1.
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def publish(self, params: Any) -> int:
+        """Snapshot device params to host and bump the version."""
+        host = jax.device_get(params)
+        with self._lock:
+            self._params = host
+            self._version += 1
+            return self._version
+
+    def get(self, have_version: int = -1) -> Optional[Tuple[Any, int]]:
+        """Return (params, version) if newer than ``have_version`` else None."""
+        with self._lock:
+            if self._params is None or self._version <= have_version:
+                return None
+            return self._params, self._version
+
+    def get_blocking(self, timeout: float = 30.0) -> Tuple[Any, int]:
+        """Wait for the first publication (actors at startup — the analogue
+        of the reference's construct-learner-before-actors ordering
+        constraint, main.py:44)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            got = self.get(-1)
+            if got is not None:
+                return got
+            time.sleep(0.01)
+        raise TimeoutError("no parameters published within timeout")

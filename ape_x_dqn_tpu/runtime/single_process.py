@@ -25,17 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ape_x_dqn_tpu.actors import ActorFleet, EpisodeStat, LocalParamSource
+from ape_x_dqn_tpu.actors import EpisodeStat, LocalParamSource
 from ape_x_dqn_tpu.config import ApexConfig
-from ape_x_dqn_tpu.envs import make_env
-from ape_x_dqn_tpu.learner.train_step import (
-    build_train_step,
-    init_train_state,
-    make_optimizer,
-)
-from ape_x_dqn_tpu.models.dueling import build_network
-from ape_x_dqn_tpu.replay import PrioritizedReplay
-from ape_x_dqn_tpu.types import PrioritizedBatch
 
 
 class IterationResult(NamedTuple):
@@ -56,100 +47,23 @@ def beta_schedule(step: int, total_steps: int, beta0: float) -> float:
 
 class SingleProcessDriver:
     def __init__(self, cfg: ApexConfig, learner_steps_per_iter: int = 1):
-        cfg.validate()
-        self.cfg = cfg
+        from ape_x_dqn_tpu.runtime.components import build_components
+
+        comps = build_components(cfg)
+        self.cfg = comps.cfg
         self.learner_steps_per_iter = learner_steps_per_iter
-
-        self._env_kwargs = dict(
-            frame_skip=cfg.env.frame_skip,
-            frame_stack=cfg.env.frame_stack,
-            episodic_life=cfg.env.episodic_life,
-            clip_rewards=cfg.env.clip_rewards,
-        )
-        probe = make_env(cfg.env.name, seed=cfg.seed, **self._env_kwargs)
-        obs_shape = probe.observation_shape
-        num_actions = probe.num_actions
-        if cfg.env.state_shape is not None:
-            want = tuple(cfg.env.state_shape)
-            got = tuple(obs_shape)
-            # Accept the reference's CHW spelling ([1, 84, 84],
-            # parameters.json:3) for our HWC layout.
-            chw_of_got = (got[-1], *got[:-1]) if len(got) == 3 else got
-            if want != got and want != chw_of_got:
-                raise ValueError(
-                    f"config env.state_shape {want} != actual {got}"
-                )
-        if cfg.env.action_dim is not None and cfg.env.action_dim != num_actions:
-            raise ValueError(
-                f"config env.action_dim {cfg.env.action_dim} != actual {num_actions}"
-            )
-        self.obs_shape = obs_shape
-        self.num_actions = num_actions
-
-        self.network = build_network(cfg.network, num_actions)
-        optimizer = make_optimizer(
-            cfg.learner.optimizer,
-            learning_rate=cfg.learner.learning_rate,
-            max_grad_norm=cfg.learner.max_grad_norm,
-        )
-        self._optimizer = optimizer
-        sample_obs = jnp.zeros((1, *obs_shape), jnp.uint8)
-        self.state = init_train_state(
-            self.network, optimizer, jax.random.PRNGKey(cfg.seed), sample_obs
-        )
-        self._learner_step = 0
-        if cfg.learner.restore_from:
-            # Resume gate mirroring the reference's load_saved_state
-            # (learner.py:18-23) — but restoring the FULL train state, with
-            # the same missing-file fallback to scratch.  restore_from=True
-            # (the reference's boolean spelling) means "my checkpoint_dir".
-            from ape_x_dqn_tpu.utils.checkpoint import restore_checkpoint
-
-            restore_path = (
-                cfg.learner.checkpoint_dir
-                if cfg.learner.restore_from is True
-                else str(cfg.learner.restore_from)
-            )
-            try:
-                self.state, step = restore_checkpoint(restore_path, self.state)
-                self._learner_step = step
-                print(f"restored checkpoint at step {step}")
-            except FileNotFoundError:
-                print(
-                    f"WARNING: no checkpoint at {restore_path}; "
-                    "starting from scratch"
-                )
-        self.train_step = build_train_step(
-            self.network,
-            optimizer,
-            loss_kind=cfg.learner.loss,
-            target_sync_freq=cfg.learner.q_target_sync_freq,
-        )
-        self.replay = PrioritizedReplay(
-            cfg.replay.capacity,
-            obs_shape,
-            priority_exponent=cfg.replay.priority_exponent,
-        )
-        env_fns = [
-            (lambda i=i: make_env(
-                cfg.env.name, seed=cfg.seed + 1000 + i, **self._env_kwargs
-            ))
-            for i in range(cfg.actor.num_actors)
-        ]
-        self.fleet = ActorFleet(
-            env_fns,
-            self.network,
-            n_step=cfg.actor.num_steps,
-            gamma=cfg.actor.gamma,
-            epsilon=cfg.actor.epsilon,
-            epsilon_alpha=cfg.actor.alpha,
-            flush_every=cfg.actor.flush_every,
-            sync_every=cfg.actor.sync_every,
-            seed=cfg.seed,
-        )
+        self.obs_shape = comps.obs_shape
+        self.num_actions = comps.num_actions
+        self.network = comps.network
+        self._optimizer = comps.optimizer
+        self.state = comps.state
+        self._learner_step = comps.learner_step
+        self.replay = comps.replay
+        self.train_step = comps.make_train_step()
+        self._sample = comps.make_sampler(lambda: self._learner_step)
+        self.fleet = comps.make_fleet()
         self.param_source = LocalParamSource(self.state.params)
         self.fleet.sync_params(self.param_source)
-        self._sample_rng = np.random.default_rng(cfg.seed + 7)
         self.total_actor_steps = 0
 
     @property
@@ -169,12 +83,7 @@ class SingleProcessDriver:
         loss = mean_q = float("nan")
         if self.replay.size() >= cfg.learner.min_replay_mem_size:
             for _ in range(self.learner_steps_per_iter):
-                beta = beta_schedule(
-                    self.learner_step, cfg.learner.total_steps, cfg.replay.is_exponent
-                )
-                batch = self.replay.sample(
-                    cfg.learner.replay_sample_size, beta=beta, rng=self._sample_rng
-                )
+                batch = self._sample()
                 self.state, metrics = self.train_step(self.state, batch)
                 self._learner_step += 1
                 self.replay.update_priorities(

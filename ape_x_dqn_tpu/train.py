@@ -1,0 +1,98 @@
+"""CLI entry point: ``python -m ape_x_dqn_tpu.train [--params-file F]``.
+
+Mirrors the reference's orchestrator (``python main.py --params-file
+PARAMSFILE`` — reference main.py:12-16, README.md:15-16) with the same
+config vocabulary (the reference's parameters.json loads directly) plus:
+
+  * ``--set section.field=value`` overrides (no editing JSON to try a knob);
+  * ``--mode async|sync`` — the async actors∥replay∥learner pipeline
+    (default, the Ape-X architecture) or the deterministic single-process
+    round-robin (the race-free golden path, SURVEY §5);
+  * ``--steps N`` learner-step cap (the reference hard-codes T=500000 in
+    code, main.py:46);
+  * JSONL metrics to stdout and optionally ``--metrics-file``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ape_x_dqn_tpu.config import load_config, to_dict
+from ape_x_dqn_tpu.utils.metrics import MetricLogger
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ape_x_dqn_tpu.train",
+        description="TPU-native Ape-X DQN trainer",
+    )
+    p.add_argument(
+        "--params-file",
+        default=None,
+        help="JSON config (native or reference parameters.json format)",
+    )
+    p.add_argument(
+        "--set",
+        dest="overrides",
+        action="append",
+        default=[],
+        metavar="PATH=VALUE",
+        help="config override, e.g. --set actor.num_actors=64",
+    )
+    p.add_argument("--mode", choices=("async", "sync"), default="async")
+    p.add_argument(
+        "--steps", type=int, default=None, help="learner steps (default: config)"
+    )
+    p.add_argument("--metrics-file", default=None, help="also write JSONL here")
+    p.add_argument("--log-every", type=int, default=500)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    cfg = load_config(args.params_file, overrides=args.overrides)
+    print("config:", to_dict(cfg), file=sys.stderr)
+    logger = MetricLogger(stream=sys.stdout, path=args.metrics_file)
+    if args.mode == "async":
+        from ape_x_dqn_tpu.runtime import AsyncPipeline
+
+        pipe = AsyncPipeline(cfg, logger=logger, log_every=args.log_every)
+        final = pipe.run(learner_steps=args.steps)
+        print("final:", final, file=sys.stderr)
+    else:
+        from ape_x_dqn_tpu.runtime import SingleProcessDriver
+
+        driver = SingleProcessDriver(cfg)
+        target = args.steps if args.steps is not None else cfg.learner.total_steps
+        while driver.learner_step < target:
+            res = driver.run_iteration()
+            for e in res.episodes:
+                logger.log("episode/return", e.episode_return)
+                logger.log("episode/length", e.episode_length)
+            if res.loss == res.loss:  # not NaN
+                logger.log("learner/loss", res.loss)
+                logger.log("learner/mean_q", res.mean_q)
+            if (
+                driver.learner_step
+                and driver.learner_step % args.log_every == 0
+            ):
+                logger.emit(
+                    step=driver.learner_step,
+                    actor_steps=res.actor_steps,
+                    replay_size=res.replay_size,
+                )
+            if driver.fleet.step_count >= cfg.actor.T:
+                break
+        logger.emit(
+            step=driver.learner_step,
+            actor_steps=driver.total_actor_steps,
+            replay_size=driver.replay.size(),
+            final=True,
+        )
+    logger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

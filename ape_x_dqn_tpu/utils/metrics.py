@@ -1,0 +1,108 @@
+"""Structured metrics: counters, rates, JSONL stream.
+
+The reference's entire observability story is ``print`` — a per-step console
+write *on the actor hot path* (reference actor.py:170 with ``end='\\r'``),
+per-episode lines (actor.py:177), and a commented-out loss print
+(learner.py:71) (SURVEY §5 metrics subsystem).  Here metrics are first-class:
+named scalar streams aggregated host-side, emitted as JSONL (machine-
+readable, greppable) at a capped rate — never per step — plus rate counters
+for the north-star throughput numbers (learner steps/sec, actor FPS).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from collections import defaultdict
+from typing import IO, Dict, Optional
+
+
+class RateCounter:
+    """Events/second over a sliding window, cheap enough for hot paths."""
+
+    def __init__(self, window_s: float = 10.0):
+        self._window = window_s
+        self._events: list[tuple[float, float]] = []  # (time, count)
+        self._total = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, n: float = 1.0) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._events.append((now, n))
+            self._total += n
+            cutoff = now - self._window
+            while self._events and self._events[0][0] < cutoff:
+                self._events.pop(0)
+
+    @property
+    def total(self) -> float:
+        with self._lock:
+            return self._total
+
+    def rate(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            cutoff = now - self._window
+            while self._events and self._events[0][0] < cutoff:
+                self._events.pop(0)
+            if not self._events:
+                return 0.0
+            span = max(now - self._events[0][0], 1e-9)
+            return sum(n for _, n in self._events) / span
+
+
+class MetricLogger:
+    """Aggregate scalars between emits; write one JSONL record per emit.
+
+    ``log(name, value)`` accumulates (mean/min/max/count per emit window);
+    ``emit(**extra)`` flushes a record.  Thread-safe; writers share one
+    logger.
+    """
+
+    def __init__(self, stream: Optional[IO] = None, path: Optional[str] = None):
+        self._streams: list[IO] = []
+        if stream is not None:
+            self._streams.append(stream)
+        self._file = open(path, "a") if path else None
+        if self._file:
+            self._streams.append(self._file)
+        if not self._streams:
+            self._streams.append(sys.stdout)
+        self._acc: Dict[str, list] = defaultdict(list)
+        self._lock = threading.Lock()
+        self._start = time.monotonic()
+
+    def log(self, name: str, value: float) -> None:
+        with self._lock:
+            self._acc[name].append(float(value))
+
+    def emit(self, **extra) -> dict:
+        with self._lock:
+            record: dict = {"t": round(time.monotonic() - self._start, 3)}
+            for name, vals in self._acc.items():
+                if not vals:
+                    continue
+                if len(vals) == 1:
+                    record[name] = vals[0]
+                else:
+                    record[name] = sum(vals) / len(vals)
+                    record[f"{name}/max"] = max(vals)
+                    record[f"{name}/min"] = min(vals)
+                    record[f"{name}/n"] = len(vals)
+            self._acc.clear()
+        record.update(extra)
+        line = json.dumps(record)
+        for s in self._streams:
+            try:
+                s.write(line + "\n")
+                s.flush()
+            except ValueError:  # closed stream
+                pass
+        return record
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()

@@ -1,0 +1,63 @@
+"""CLI trainer tests: both modes end-to-end via main(argv)."""
+
+import json
+
+import pytest
+
+from ape_x_dqn_tpu.train import main
+
+BASE_ARGS = [
+    "--set", "env.name=chain:6",
+    "--set", "network=mlp",
+    "--set", "actor.num_actors=2",
+    "--set", "actor.flush_every=8",
+    "--set", "learner.min_replay_mem_size=128",
+    "--set", "replay.capacity=2000",
+    "--set", "learner.optimizer=adam",
+    "--log-every", "20",
+]
+
+
+def test_sync_mode(capsys, tmp_path):
+    rc = main(BASE_ARGS + ["--mode", "sync", "--steps", "40",
+                           "--metrics-file", str(tmp_path / "m.jsonl")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    records = [json.loads(l) for l in out.splitlines() if l.strip()]
+    assert records and records[-1].get("final")
+    assert records[-1]["step"] == 40
+    assert (tmp_path / "m.jsonl").read_text().strip()
+
+
+def test_async_mode(capsys):
+    rc = main(BASE_ARGS + ["--mode", "async", "--steps", "60"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    records = [json.loads(l) for l in out.splitlines() if l.strip()]
+    assert records[-1]["step"] == 60
+    assert records[-1]["replay_size"] >= 128
+
+
+def test_reference_params_file(tmp_path, capsys):
+    """The actual reference parameters.json vocabulary drives the CLI."""
+    ref = {
+        "env_conf": {"state_shape": [6], "action_dim": 2, "name": "chain:6"},
+        "Actor": {"num_actors": 2, "T": 1000, "num_steps": 3, "epsilon": 0.4,
+                  "alpha": 7, "gamma": 0.9, "n_step_transition_batch_size": 8,
+                  "Q_network_sync_freq": 50},
+        "Learner": {"remove_old_xp_freq": 100, "q_target_sync_freq": 100,
+                    "min_replay_mem_size": 128, "replay_sample_size": 16,
+                    "load_saved_state": False},
+        "Replay_Memory": {"soft_capacity": 2000, "priority_exponent": 0.6,
+                          "importance_sampling_exponent": 0.4},
+    }
+    f = tmp_path / "params.json"
+    f.write_text(json.dumps(ref))
+    rc = main(["--params-file", str(f), "--set", "network=mlp",
+               "--mode", "sync", "--steps", "10", "--log-every", "5"])
+    assert rc == 0
+
+
+def test_bad_override_exits_with_error():
+    with pytest.raises(ValueError):
+        main(BASE_ARGS + ["--set", "bogus.key=1", "--steps", "1"])
