@@ -1,0 +1,175 @@
+"""Device-resident prioritized replay — sample, train, and restamp in-graph.
+
+The host replay (replay/buffer.py) re-ships a frame batch host→device on
+every learner step.  This module keeps the whole buffer in HBM as a pytree of
+jax arrays, so after an actor chunk crosses the PCIe/tunnel boundary *once*,
+everything else — ring insert, stratified prioritized sampling, IS weights,
+the train step, and the priority write-back — runs inside XLA programs with
+zero further transfers.  ``build_fused_learn_step`` goes further and fuses
+ingest + K train steps into ONE dispatch (`lax.scan` over sampled batches),
+amortizing host dispatch overhead — the single-chip path to the north-star
+steps/sec (SURVEY §7 hard parts #1-2 collapse into on-device ops).
+
+Sampling is flat prefix-sum inverse-CDF, not a tree: on TPU a cumsum over
+the priority vector is one bandwidth-bound pass that the VPU eats (and the
+pallas kernel in ops/pallas/sampling.py does it without materializing the
+prefix array); an O(log N) pointer-chasing tree would serialize on exactly
+the hardware that hates it.  Same math as the host sum-tree: mass ∝ p^α,
+stratified targets, β-annealed IS weights (reference replay.py:24-30
+semantics, reference defects excluded per SURVEY §2.8).
+
+All mutating functions are functional (state in, state out) and meant to be
+jitted with donation so ring writes happen in place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ape_x_dqn_tpu.ops.pallas.sampling import sample_indices
+from ape_x_dqn_tpu.types import NStepTransition, PrioritizedBatch
+
+
+@struct.dataclass
+class DeviceReplayState:
+    obs: jax.Array          # uint8 [C, *obs_shape]
+    next_obs: jax.Array     # uint8 [C, *obs_shape]
+    action: jax.Array       # int32 [C]
+    reward: jax.Array       # float32 [C]
+    discount: jax.Array     # float32 [C]
+    mass: jax.Array         # float32 [C] — p^α, 0 marks an empty slot
+    cursor: jax.Array       # int32 []
+    count: jax.Array        # int32 [] — total ever added (saturating view: size = min(count, C))
+
+    @property
+    def capacity(self) -> int:
+        return self.mass.shape[0]
+
+
+def init_device_replay(capacity: int, obs_shape, obs_dtype=jnp.uint8) -> DeviceReplayState:
+    return DeviceReplayState(
+        obs=jnp.zeros((capacity, *obs_shape), obs_dtype),
+        next_obs=jnp.zeros((capacity, *obs_shape), obs_dtype),
+        action=jnp.zeros((capacity,), jnp.int32),
+        reward=jnp.zeros((capacity,), jnp.float32),
+        discount=jnp.zeros((capacity,), jnp.float32),
+        mass=jnp.zeros((capacity,), jnp.float32),
+        cursor=jnp.zeros((), jnp.int32),
+        count=jnp.zeros((), jnp.int32),
+    )
+
+
+def device_replay_add(
+    state: DeviceReplayState,
+    transitions: NStepTransition,
+    priorities: jax.Array,
+    priority_exponent: float = 0.6,
+) -> DeviceReplayState:
+    """Ring-insert a chunk (batch M static).  FIFO overwrite == eviction,
+    and the slot's mass is replaced — no stale-priority leak."""
+    M = priorities.shape[0]
+    idx = (state.cursor + jnp.arange(M, dtype=jnp.int32)) % state.capacity
+    mass = jnp.power(jnp.maximum(priorities.astype(jnp.float32), 1e-12),
+                     priority_exponent)
+    return state.replace(
+        obs=state.obs.at[idx].set(transitions.obs),
+        next_obs=state.next_obs.at[idx].set(transitions.next_obs),
+        action=state.action.at[idx].set(transitions.action.astype(jnp.int32)),
+        reward=state.reward.at[idx].set(transitions.reward),
+        discount=state.discount.at[idx].set(transitions.discount),
+        mass=state.mass.at[idx].set(mass),
+        cursor=(state.cursor + M) % state.capacity,
+        count=state.count + M,
+    )
+
+
+def device_replay_sample(
+    state: DeviceReplayState,
+    rng: jax.Array,
+    batch_size: int,
+    beta: jax.Array | float = 0.4,
+) -> PrioritizedBatch:
+    """Stratified proportional sample with IS weights, fully on device."""
+    total = jnp.sum(state.mass)
+    bounds = total / batch_size
+    u = jax.random.uniform(rng, (batch_size,))
+    targets = (jnp.arange(batch_size, dtype=jnp.float32) + u) * bounds
+    targets = jnp.minimum(targets, total * (1.0 - 1e-7))
+    idx = sample_indices(state.mass, targets)
+    size = jnp.minimum(state.count, state.capacity).astype(jnp.float32)
+    probs = state.mass[idx] / jnp.maximum(total, 1e-12)
+    weights = jnp.power(jnp.maximum(size * probs, 1e-12), -beta)
+    weights = weights / jnp.max(weights)
+    return PrioritizedBatch(
+        transition=NStepTransition(
+            obs=state.obs[idx],
+            action=state.action[idx],
+            reward=state.reward[idx],
+            discount=state.discount[idx],
+            next_obs=state.next_obs[idx],
+        ),
+        indices=idx,
+        is_weights=weights.astype(jnp.float32),
+    )
+
+
+def device_replay_update_priorities(
+    state: DeviceReplayState,
+    indices: jax.Array,
+    priorities: jax.Array,
+    priority_exponent: float = 0.6,
+) -> DeviceReplayState:
+    mass = jnp.power(jnp.maximum(priorities.astype(jnp.float32), 1e-12),
+                     priority_exponent)
+    return state.replace(mass=state.mass.at[indices].set(mass))
+
+
+def build_fused_learn_step(
+    train_step_fn,
+    batch_size: int,
+    steps_per_call: int = 1,
+    priority_exponent: float = 0.6,
+    jit: bool = True,
+):
+    """Fuse [ingest chunk] → scan_K [sample → train → restamp] into one
+    XLA program.
+
+    Args:
+      train_step_fn: the *unjitted* fused train step
+        (``build_train_step(..., jit=False)``).
+      batch_size: replay sample size per learner step (static).
+      steps_per_call: K learner steps per dispatch; host overhead amortizes
+        by K (the chunk ingest happens once per call).
+
+    Returns ``fn(train_state, replay_state, chunk, chunk_priorities, beta,
+    rng) -> (train_state, replay_state, metrics)`` with metrics stacked
+    [K, ...]; jitted with both states donated.
+    """
+
+    def fused(train_state, replay_state, chunk, chunk_priorities, beta, rng):
+        replay_state = device_replay_add(
+            replay_state, chunk, chunk_priorities, priority_exponent
+        )
+
+        def body(carry, step_rng):
+            t_state, r_state = carry
+            batch = device_replay_sample(r_state, step_rng, batch_size, beta)
+            t_state, metrics = train_step_fn(t_state, batch)
+            r_state = device_replay_update_priorities(
+                r_state, batch.indices, metrics.priorities, priority_exponent
+            )
+            return (t_state, r_state), metrics
+
+        rngs = jax.random.split(rng, steps_per_call)
+        (train_state, replay_state), metrics = jax.lax.scan(
+            body, (train_state, replay_state), rngs
+        )
+        return train_state, replay_state, metrics
+
+    if jit:
+        return jax.jit(fused, donate_argnums=(0, 1))
+    return fused
