@@ -78,6 +78,15 @@ class LearnerConfig:
     # pull-rarely mismatch this cap fixes (SURVEY §2 backend entry).
     checkpoint_every: int = 0             # steps; 0 disables
     checkpoint_dir: str = "checkpoints"
+    # Device-resident fused path (replay/device.py): replay lives in HBM and
+    # each dispatch runs steps_per_call sample/train/restamp steps — the
+    # throughput mode; False = host replay + per-step train (golden path).
+    device_replay: bool = False
+    steps_per_call: int = 128             # K steps fused per dispatch
+    # HBM-traffic knobs ("bfloat16" | None): reduced-precision RMSProp
+    # second moment and target net — see make_optimizer / init_train_state.
+    second_moment_dtype: Optional[str] = None
+    target_dtype: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -120,6 +129,13 @@ class ApexConfig:
             (l.optimizer in ("rmsprop", "adam"),
              f"unknown optimizer kind: {l.optimizer}"),
             (l.loss in ("huber", "squared"), f"unknown loss kind: {l.loss}"),
+            (l.steps_per_call >= 1, "learner.steps_per_call must be >= 1"),
+            (l.second_moment_dtype in (None, "bfloat16", "float32"),
+             f"unknown second_moment_dtype: {l.second_moment_dtype}"),
+            (l.target_dtype in (None, "bfloat16", "float32"),
+             f"unknown target_dtype: {l.target_dtype}"),
+            (not (l.second_moment_dtype is not None and l.optimizer == "adam"),
+             "second_moment_dtype is only supported for rmsprop"),
         ]
         for ok, msg in checks:
             if not ok:
@@ -170,7 +186,17 @@ def from_reference_json(data: dict) -> ApexConfig:
     return cfg.validate()
 
 
-def _coerce(current: Any, raw: str) -> Any:
+# Optional-typed fields where a CLI "none" legitimately means None; anywhere
+# else "none" falls through to the typed coercion and raises clearly.
+_OPTIONAL_FIELDS = {
+    "state_shape", "action_dim", "max_grad_norm",
+    "second_moment_dtype", "target_dtype",
+}
+
+
+def _coerce(current: Any, raw: str, field: str = "") -> Any:
+    if raw.lower() in ("none", "null") and field in _OPTIONAL_FIELDS:
+        return None
     if isinstance(current, bool):
         # bool-defaulted fields may be str|bool unions (learner.restore_from:
         # False or a checkpoint path) — only coerce clearly boolean words,
@@ -204,7 +230,7 @@ def apply_overrides(cfg: ApexConfig, overrides: Sequence[str]) -> ApexConfig:
         field = parts[-1]
         if not hasattr(obj, field):
             raise ValueError(f"unknown config field: {path}")
-        setattr(obj, field, _coerce(getattr(obj, field), raw))
+        setattr(obj, field, _coerce(getattr(obj, field), raw, field))
     return cfg.validate()
 
 

@@ -72,6 +72,14 @@ def device_replay_add(
     """Ring-insert a chunk (batch M static).  FIFO overwrite == eviction,
     and the slot's mass is replaced — no stale-priority leak."""
     M = priorities.shape[0]
+    if M > state.capacity:
+        # A chunk wider than the ring would wrap idx onto itself, and XLA
+        # scatter with duplicate indices has unspecified write order —
+        # silent ring corruption.  Static shapes make this a build-time
+        # check (mirrors PrioritizedReplay.add's host-side guard).
+        raise ValueError(
+            f"chunk of {M} transitions exceeds replay capacity {state.capacity}"
+        )
     idx = (state.cursor + jnp.arange(M, dtype=jnp.int32)) % state.capacity
     mass = jnp.power(jnp.maximum(priorities.astype(jnp.float32), 1e-12),
                      priority_exponent)
@@ -133,6 +141,8 @@ def build_fused_learn_step(
     batch_size: int,
     steps_per_call: int = 1,
     priority_exponent: float = 0.6,
+    target_sync_freq: int | None = 2500,
+    include_ingest: bool = True,
     jit: bool = True,
 ):
     """Fuse [ingest chunk] → scan_K [sample → train → restamp] into one
@@ -140,20 +150,39 @@ def build_fused_learn_step(
 
     Args:
       train_step_fn: the *unjitted* fused train step
-        (``build_train_step(..., jit=False)``).
+        (``build_train_step(..., jit=False)``).  When ``target_sync_freq``
+        is set here, build it with ``sync_in_step=False`` — the per-step
+        target-pytree rewrite costs ~95 µs/step on a v5e and is pure waste
+        between the every-``freq``-step syncs.
       batch_size: replay sample size per learner step (static).
       steps_per_call: K learner steps per dispatch; host overhead amortizes
         by K (the chunk ingest happens once per call).
+      target_sync_freq: hoisted target sync — after the K-step scan, copy
+        online → target params iff the scan crossed a multiple of ``freq``.
+        Exact when ``freq % K == 0`` (the crossing lands on a call
+        boundary); otherwise the sync lands at the first boundary after the
+        crossing, ≤ K−1 steps late — noise next to Ape-X's 2500-step
+        staleness.  ``None`` = the train step handles sync itself
+        (``sync_in_step=True``).
+
+      include_ingest: with True (default) each call ingests one chunk
+        before the scan — one dispatch total, the bench/bulk path.  With
+        False the signature drops ``chunk``/``chunk_priorities`` and the
+        caller ingests at its own cadence via ``device_replay_add`` — the
+        async runtime's shape, where actor chunks arrive on their own clock.
 
     Returns ``fn(train_state, replay_state, chunk, chunk_priorities, beta,
-    rng) -> (train_state, replay_state, metrics)`` with metrics stacked
-    [K, ...]; jitted with both states donated.
+    rng) -> (train_state, replay_state, metrics)`` (without the chunk args
+    when ``include_ingest=False``) with metrics stacked [K, ...]; jitted
+    with both states donated.
     """
 
     def fused(train_state, replay_state, chunk, chunk_priorities, beta, rng):
-        replay_state = device_replay_add(
-            replay_state, chunk, chunk_priorities, priority_exponent
-        )
+        step_before = train_state.step
+        if include_ingest:
+            replay_state = device_replay_add(
+                replay_state, chunk, chunk_priorities, priority_exponent
+            )
 
         def body(carry, step_rng):
             t_state, r_state = carry
@@ -168,7 +197,28 @@ def build_fused_learn_step(
         (train_state, replay_state), metrics = jax.lax.scan(
             body, (train_state, replay_state), rngs
         )
+        if target_sync_freq is not None:
+            crossed = (train_state.step // target_sync_freq) > (
+                step_before // target_sync_freq
+            )
+            train_state = train_state.replace(
+                target_params=jax.tree_util.tree_map(
+                    lambda online, target: jnp.where(
+                        crossed, online.astype(target.dtype), target
+                    ),
+                    train_state.params,
+                    train_state.target_params,
+                )
+            )
         return train_state, replay_state, metrics
+
+    if not include_ingest:
+        inner = fused
+
+        def fused_no_ingest(train_state, replay_state, beta, rng):
+            return inner(train_state, replay_state, None, None, beta, rng)
+
+        fused = fused_no_ingest
 
     if jit:
         return jax.jit(fused, donate_argnums=(0, 1))

@@ -50,7 +50,8 @@ class _ActorWorker:
 
     def __init__(self, comps, store: ParamStore, stop: threading.Event,
                  logger: MetricLogger, fps: RateCounter,
-                 max_restarts: int = 3, quantum: Optional[int] = None):
+                 max_restarts: int = 3, quantum: Optional[int] = None,
+                 sink=None):
         self._comps = comps
         self._store = store
         self._stop = stop
@@ -58,6 +59,12 @@ class _ActorWorker:
         self._fps = fps
         self._max_restarts = max_restarts
         self._quantum = quantum or comps.cfg.actor.flush_every
+        # Where chunks go: the host replay by default, or any
+        # (priorities, transitions) callable (the fused learner's staging
+        # sink in device-replay mode).
+        self._sink = sink if sink is not None else (
+            lambda prio, trans: comps.replay.add(prio, trans)
+        )
         self.restarts = 0
         self.finished = False  # clean exit (actor.T reached), not a crash
         self.heartbeat = time.monotonic()
@@ -103,7 +110,7 @@ class _ActorWorker:
         while not self._stop.is_set() and fleet.step_count < max_steps:
             chunks, stats = fleet.collect(self._quantum, param_source=self._store)
             for chunk in chunks:
-                self._comps.replay.add(chunk.priorities, chunk.transitions)
+                self._sink(chunk.priorities, chunk.transitions)
                 self.actor_steps += chunk.actor_steps
                 self._fps.add(chunk.actor_steps)
             if stats:
@@ -127,41 +134,55 @@ class AsyncPipeline:
         self.cfg = self.comps.cfg
         self.logger = logger or MetricLogger()
         self.log_every = log_every
-        self.train_step = self.comps.make_train_step()
         self.store = ParamStore(self.comps.state.params)
         self.stop_event = threading.Event()
         self._fps = RateCounter()
         self._steps_rate = RateCounter()
         self._prefetch_depth = prefetch_depth
+        self.fused = None
+        sink = None
+        if self.cfg.learner.device_replay:
+            self.fused = self.comps.make_fused_learner()
+            sink = self.fused.add_chunk
+            self.train_step = None
+        else:
+            self.train_step = self.comps.make_train_step()
         self.worker = _ActorWorker(
             self.comps, self.store, self.stop_event, self.logger, self._fps,
-            max_restarts=max_actor_restarts,
+            max_restarts=max_actor_restarts, sink=sink,
         )
         self._learner_step = self.comps.learner_step
-        self._sample = self.comps.make_sampler(lambda: self._learner_step)
+        self._sample = (
+            None if self.fused is not None
+            else self.comps.make_sampler(lambda: self._learner_step)
+        )
         self.episode_returns: List[float] = []
 
     @property
     def learner_step(self) -> int:
         return self._learner_step
 
-    def _wait_for_warmup(self, timeout: float):
+    def _wait_for_warmup(self, timeout: float, size_fn=None, tick=None):
         """Block until replay holds min_replay_mem_size transitions
-        (reference learner.py:64-65's poll loop)."""
+        (reference learner.py:64-65's poll loop).  ``tick`` runs each poll
+        (the fused mode ingests staged chunks with it)."""
+        size_fn = size_fn or self.comps.replay.size
         deadline = time.monotonic() + timeout
-        while self.comps.replay.size() < self.cfg.learner.min_replay_mem_size:
+        while size_fn() < self.cfg.learner.min_replay_mem_size:
+            if tick is not None:
+                tick()
             if self.stop_event.is_set():
                 raise RuntimeError("actors stopped during warmup") from self.worker.error
-            if self.worker.finished:
+            if self.worker.finished and size_fn() < self.cfg.learner.min_replay_mem_size:
                 raise RuntimeError(
                     f"actors exhausted actor.T={self.cfg.actor.T} env steps "
-                    f"with replay at {self.comps.replay.size()} / "
+                    f"with replay at {size_fn()} / "
                     f"{self.cfg.learner.min_replay_mem_size} — raise actor.T "
                     "or lower learner.min_replay_mem_size"
                 )
             if time.monotonic() > deadline:
                 raise TimeoutError(
-                    f"replay warmup stalled at {self.comps.replay.size()} / "
+                    f"replay warmup stalled at {size_fn()} / "
                     f"{self.cfg.learner.min_replay_mem_size}"
                 )
             time.sleep(0.05)
@@ -173,6 +194,8 @@ class AsyncPipeline:
     ) -> dict:
         cfg = self.cfg
         target = learner_steps if learner_steps is not None else cfg.learner.total_steps
+        if self.fused is not None:
+            return self._run_fused(target, warmup_timeout)
         self.worker.start()
         try:
             self._wait_for_warmup(warmup_timeout)
@@ -222,6 +245,90 @@ class AsyncPipeline:
         if self.worker.error is not None:
             raise RuntimeError("actor worker died") from self.worker.error
         return self._emit(final=True)
+
+    def _run_fused(self, target: int, warmup_timeout: float) -> dict:
+        """Device-replay mode: ingest staged actor chunks, then fused
+        K-step calls — sample/train/restamp never leave HBM."""
+        import numpy as np
+
+        from ape_x_dqn_tpu.runtime.single_process import beta_schedule
+
+        cfg = self.cfg
+        fused = self.fused
+        self.worker.start()
+        last_metrics = None
+        try:
+            self._wait_for_warmup(
+                warmup_timeout,
+                size_fn=lambda: fused.size,
+                tick=fused.ingest_staged,
+            )
+            next_log = self._learner_step + self.log_every
+            next_ckpt = (
+                self._learner_step + cfg.learner.checkpoint_every
+                if cfg.learner.checkpoint_every
+                else None
+            )
+            while self._learner_step < target and not self.stop_event.is_set():
+                fused.ingest_staged()
+                beta = beta_schedule(
+                    self._learner_step, cfg.learner.total_steps,
+                    cfg.replay.is_exponent,
+                )
+                last_metrics = fused.train(beta)
+                self._learner_step += fused.steps_per_call
+                self._steps_rate.add(fused.steps_per_call)
+                self.comps.state = fused.state
+                # Publish at most once per fused call — the cap
+                # (publish_every) is finer than K, so every call qualifies;
+                # a coarser cap than K publishes on the calls that cross it.
+                if self._learner_step % max(
+                    cfg.learner.publish_every, fused.steps_per_call
+                ) < fused.steps_per_call:
+                    self.store.publish(fused.params_for_publish())
+                if next_ckpt is not None and self._learner_step >= next_ckpt:
+                    from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
+
+                    save_checkpoint(cfg.learner.checkpoint_dir, fused.state)
+                    next_ckpt += cfg.learner.checkpoint_every
+                if self._learner_step >= next_log:
+                    self._emit_fused(last_metrics)
+                    next_log += self.log_every
+        finally:
+            self.stop_event.set()
+            self.worker.join()
+        if self.worker.error is not None:
+            raise RuntimeError("actor worker died") from self.worker.error
+        if last_metrics is not None:
+            loss = np.asarray(last_metrics.loss)
+            if not np.all(np.isfinite(loss)):
+                raise FloatingPointError("non-finite loss in fused learner")
+        return self._emit_fused(last_metrics, final=True)
+
+    def _emit_fused(self, metrics, final: bool = False) -> dict:
+        import numpy as np
+
+        eps = self.worker.drain_episodes()
+        for e in eps:
+            self.episode_returns.append(e.episode_return)
+            self.logger.log("episode/return", e.episode_return)
+            self.logger.log("episode/length", e.episode_length)
+        if metrics is not None:
+            # One host sync per log period, not per call.
+            self.logger.log("learner/loss", float(np.asarray(metrics.loss)[-1]))
+            self.logger.log("learner/mean_q", float(np.asarray(metrics.mean_q)[-1]))
+        return self.logger.emit(
+            step=self._learner_step,
+            actor_steps=self.worker.actor_steps,
+            replay_size=self.fused.size,
+            staged_rows=self.fused.staged_rows,
+            steps_per_sec=round(self._steps_rate.rate(), 1),
+            actor_fps=round(self._fps.rate(), 1),
+            param_version=self.store.version,
+            actor_restarts=self.worker.restarts,
+            actor_heartbeat_age=round(time.monotonic() - self.worker.heartbeat, 3),
+            final=final,
+        )
 
     def _place(self, host_batch):
         """Stage a host batch on device, keeping host indices for the

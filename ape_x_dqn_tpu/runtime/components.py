@@ -32,7 +32,7 @@ class Components:
     optimizer: object
     state: TrainState
     learner_step: int          # host-side mirror (== restored step or 0)
-    replay: PrioritizedReplay
+    replay: Optional[PrioritizedReplay]   # None in device-replay mode
     env_fns: List[Callable]
 
     def make_train_step(self):
@@ -66,6 +66,25 @@ class Components:
             )
 
         return sample
+
+    def make_fused_learner(self):
+        """The device-resident fused learner (HBM replay + K-step scan) —
+        the ``learner.device_replay=True`` throughput mode."""
+        from ape_x_dqn_tpu.runtime.fused_learner import FusedDeviceLearner
+
+        cfg = self.cfg
+        return FusedDeviceLearner(
+            self.network,
+            self.optimizer,
+            self.state,
+            self.obs_shape,
+            capacity=cfg.replay.capacity,
+            batch_size=cfg.learner.replay_sample_size,
+            steps_per_call=cfg.learner.steps_per_call,
+            priority_exponent=cfg.replay.priority_exponent,
+            target_sync_freq=cfg.learner.q_target_sync_freq,
+            loss_kind=cfg.learner.loss,
+        )
 
     def make_fleet(self, seed_offset: int = 0) -> ActorFleet:
         """Build a fresh actor fleet (supervisor restarts call this again —
@@ -109,14 +128,17 @@ def build_components(cfg: ApexConfig) -> Components:
         )
 
     network = build_network(cfg.network, num_actions)
+    _dtypes = {"bfloat16": jnp.bfloat16, "float32": jnp.float32, None: None}
     optimizer = make_optimizer(
         cfg.learner.optimizer,
         learning_rate=cfg.learner.learning_rate,
         max_grad_norm=cfg.learner.max_grad_norm,
+        second_moment_dtype=_dtypes[cfg.learner.second_moment_dtype],
     )
     state = init_train_state(
         network, optimizer, jax.random.PRNGKey(cfg.seed),
         jnp.zeros((1, *obs_shape), jnp.uint8),
+        target_dtype=_dtypes[cfg.learner.target_dtype],
     )
     learner_step = 0
     if cfg.learner.restore_from:
@@ -138,10 +160,15 @@ def build_components(cfg: ApexConfig) -> Components:
                 f"WARNING: no checkpoint at {restore_path}; starting from scratch"
             )
 
-    replay = PrioritizedReplay(
-        cfg.replay.capacity, obs_shape,
-        priority_exponent=cfg.replay.priority_exponent,
-    )
+    if cfg.learner.device_replay:
+        # Throughput mode keeps the ring in HBM (make_fused_learner); the
+        # host replay would be ~capacity × 2 frames of dead host RAM.
+        replay = None
+    else:
+        replay = PrioritizedReplay(
+            cfg.replay.capacity, obs_shape,
+            priority_exponent=cfg.replay.priority_exponent,
+        )
     env_fns = [
         (lambda i=i: make_env(cfg.env.name, seed=cfg.seed + 1000 + i, **env_kwargs))
         for i in range(cfg.actor.num_actors)

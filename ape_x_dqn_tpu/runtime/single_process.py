@@ -50,6 +50,11 @@ class SingleProcessDriver:
         from ape_x_dqn_tpu.runtime.components import build_components
 
         comps = build_components(cfg)
+        if comps.replay is None:
+            raise ValueError(
+                "the single-process driver is the host-replay golden path; "
+                "learner.device_replay=true runs via the async pipeline"
+            )
         self.cfg = comps.cfg
         self.learner_steps_per_iter = learner_steps_per_iter
         self.obs_shape = comps.obs_shape

@@ -1,80 +1,210 @@
-"""Benchmark: fused learner step throughput on the real chip.
+"""Benchmark: fused learner throughput on the real chip.
 
 Prints ONE JSON line:
     {"metric": "learner_steps_per_sec", "value": N, "unit": "steps/s",
-     "vs_baseline": R}
+     "vs_baseline": R, ...extra fields...}
 
-The metric is gradient steps/sec of the fully-fused train step (double-Q
-target, loss, grads, RMSProp, target-sync, per-transition priorities in one
-XLA program) on the flagship dueling conv net at the reference workload
-scale (batch 32, 84x84x1 uint8 frames — reference parameters.json:3,23).
+The metric is gradient steps/sec of the device-resident fused pipeline —
+ingest → scan_K [prioritized sample → double-Q train step → priority
+restamp] in ONE XLA dispatch (replay/device.py:build_fused_learn_step) —
+on the flagship dueling conv net at the reference workload scale (batch 32,
+84x84x1 uint8 frames, 100k-slot replay: reference parameters.json:3,23,28).
 
-The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is the
-fraction of the north-star target rate prorated to this chip count:
-50_000 steps/s on a v4-8 (4 chips) → 12_500 steps/s per chip.
+Methodology notes (both verified on hardware this round):
+  * ``jax.block_until_ready`` does NOT actually block on this tunneled
+    TPU platform — only a host transfer forces execution.  Round 1's
+    BENCH_r01.json (7,337.8 steps/s) timed dispatch, not compute; the same
+    workload measured honestly (``np.asarray`` on a value data-dependent on
+    every step) sustains ~3.7k steps/s.  This bench forces every timed call
+    through the serial train-state chain and pulls the final loss to host.
+  * Per-dispatch overhead through the tunnel is ~2-22 ms, so K steps are
+    fused per dispatch (lax.scan) and chunks are pre-staged on device —
+    overlapping host transfers with device compute is the infeed queue's
+    job (runtime/infeed.py), not the learner's.
+
+``vs_baseline`` is the fraction of the north-star rate prorated per chip:
+50_000 steps/s on a v4-8 (4 chips) → 12_500/chip (BASELINE.md).  The chip
+here is a v5e (819 GB/s HBM vs v4's 1,228 GB/s); the fused step is HBM-bound
+(RMSProp + params traffic), so the proration is conservative by ~1.5x.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 NORTH_STAR_PER_CHIP = 50_000 / 4.0
 
 
+def _make_chunks(rng, n, m, obs_shape, num_actions):
+    import jax
+    import jax.numpy as jnp
+
+    from ape_x_dqn_tpu.types import NStepTransition
+
+    chunks = []
+    for _ in range(n):
+        chunks.append(
+            jax.device_put(
+                NStepTransition(
+                    obs=jnp.asarray(
+                        rng.integers(0, 255, (m, *obs_shape), dtype=np.uint8)
+                    ),
+                    action=jnp.asarray(
+                        rng.integers(0, num_actions, (m,), dtype=np.int32)
+                    ),
+                    reward=jnp.asarray(rng.normal(size=(m,)).astype(np.float32)),
+                    discount=jnp.full((m,), 0.97, jnp.float32),
+                    next_obs=jnp.asarray(
+                        rng.integers(0, 255, (m, *obs_shape), dtype=np.uint8)
+                    ),
+                )
+            )
+        )
+    return chunks
+
+
+def _validate_samplers(rng) -> dict:
+    """Run all three sampler spellings on the real chip at 2M slots and
+    report agreement with an exact float64 host oracle (VERDICT item 3)."""
+    import jax.numpy as jnp
+
+    from ape_x_dqn_tpu.ops.pallas.sampling import (
+        _pallas_sample,
+        _two_level_sample,
+        _xla_sample,
+    )
+
+    C, B = 1 << 21, 32
+    p_np = rng.random(C, dtype=np.float32) + 1e-3
+    p = jnp.asarray(p_np)
+    total = float(np.sum(p_np.astype(np.float64)))
+    t_np = (rng.random(B) * total).astype(np.float32)
+    t = jnp.asarray(t_np)
+    cdf64 = np.cumsum(p_np.astype(np.float64))
+    exact = np.searchsorted(cdf64, t_np.astype(np.float64), side="right")
+
+    out = {}
+    for name, fn in (
+        ("two_level", _two_level_sample),
+        ("pallas", _pallas_sample),
+        ("xla", _xla_sample),
+    ):
+        idx = np.asarray(fn(p, t))
+        # float32 accumulation-order shifts boundaries by a few leaves out
+        # of 2M — mass-proportionally immaterial; >64 would be a logic bug.
+        # No standalone timing: per-call dispatch on this platform costs a
+        # program-dependent fixed ~2-120 ms that swamps any µs-scale kernel
+        # (measured: scan iteration count doesn't change wall time).  The
+        # sampler's real cost is part of the fused us_per_step headline.
+        max_err = int(np.max(np.abs(idx - exact)))
+        assert max_err <= 64, f"{name} sampler diverged from f64 oracle: {max_err}"
+        out[name] = {"max_leaf_err_2m": max_err}
+    return out
+
+
 def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps-per-call", type=int, default=1024)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--capacity", type=int, default=100_000)
+    parser.add_argument("--timed-calls", type=int, default=8)
+    parser.add_argument(
+        "--skip-sampler-validation", action="store_true",
+        help="skip the 2M-slot sampler parity check (saves ~30s)",
+    )
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
     from ape_x_dqn_tpu.learner.train_step import (
         build_train_step,
         init_train_state,
         make_optimizer,
     )
     from ape_x_dqn_tpu.models.dueling import build_network
-    from ape_x_dqn_tpu.types import NStepTransition, PrioritizedBatch
-
-    B, obs_shape, A = 32, (84, 84, 1), 4
-    net = build_network("conv", A)
-    opt = make_optimizer("rmsprop")
-    state = init_train_state(
-        net, opt, jax.random.PRNGKey(0), jnp.zeros((1, *obs_shape), jnp.uint8)
+    from ape_x_dqn_tpu.replay.device import (
+        build_fused_learn_step,
+        device_replay_add,
+        init_device_replay,
     )
-    step = build_train_step(net, opt)
+
+    B, K, C = args.batch_size, args.steps_per_call, args.capacity
+    obs_shape, A, M = (84, 84, 1), 4, 256
+    target_sync_freq = 2500 - 2500 % K if K <= 2500 else K  # multiple of K
+
+    net = build_network("conv", A)
+    # Reference-parity RMSProp with the HBM-traffic knobs: no global-norm
+    # clip (the reference has none), bfloat16 second moment + target net
+    # (chain-MDP learning test covers this mode).
+    opt = make_optimizer(
+        "rmsprop", max_grad_norm=None, second_moment_dtype=jnp.bfloat16
+    )
+    step_fn = build_train_step(net, opt, sync_in_step=False, jit=False)
+    fused = build_fused_learn_step(
+        step_fn, B, steps_per_call=K, target_sync_freq=target_sync_freq
+    )
 
     rng = np.random.default_rng(0)
-    n_batches = 8
-    batches = [
-        jax.device_put(
-            PrioritizedBatch(
-                transition=NStepTransition(
-                    obs=rng.integers(0, 255, (B, *obs_shape), dtype=np.uint8),
-                    action=rng.integers(0, A, (B,), dtype=np.int32),
-                    reward=rng.normal(size=(B,)).astype(np.float32),
-                    discount=np.full((B,), 0.97, np.float32),
-                    next_obs=rng.integers(0, 255, (B, *obs_shape), dtype=np.uint8),
-                ),
-                indices=np.arange(B, dtype=np.int32),
-                is_weights=np.ones((B,), np.float32),
-            )
+    chunks = _make_chunks(rng, 4, M, obs_shape, A)
+    prio = jax.device_put(jnp.ones((M,), jnp.float32))
+
+    replay = init_device_replay(C, obs_shape)
+    add = jax.jit(device_replay_add, donate_argnums=(0,))
+    for i in range(40):  # prefill past min_replay_size
+        replay = add(replay, chunks[i % len(chunks)], prio)
+    state = init_train_state(
+        net,
+        opt,
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, *obs_shape), jnp.uint8),
+        target_dtype=jnp.bfloat16,
+    )
+
+    key = jax.random.PRNGKey(1)
+    for i in range(2):  # compile + steady-state warmup
+        key, sub = jax.random.split(key)
+        state, replay, metrics = fused(
+            state, replay, chunks[i % len(chunks)], prio, 0.4, sub
         )
-        for _ in range(n_batches)
-    ]
+    _ = np.asarray(metrics.loss)
 
-    # Warmup: compile + a few steps.
-    for i in range(3):
-        state, metrics = step(state, batches[i % n_batches])
-    jax.block_until_ready(metrics.loss)
-
-    steps = 600
+    calls = args.timed_calls
     t0 = time.perf_counter()
-    for i in range(steps):
-        state, metrics = step(state, batches[i % n_batches])
-    jax.block_until_ready(metrics.loss)
+    for i in range(calls):
+        key, sub = jax.random.split(key)
+        state, replay, metrics = fused(
+            state, replay, chunks[i % len(chunks)], prio, 0.4, sub
+        )
+    final_loss = np.asarray(metrics.loss)  # serial chain forces all calls
     dt = time.perf_counter() - t0
+    assert np.all(np.isfinite(final_loss)), "non-finite loss in bench"
 
-    rate = steps / dt
+    rate = calls * K / dt
+    extra = {
+        "us_per_step": round(dt / (calls * K) * 1e6, 1),
+        "samples_per_sec": round(rate * B),
+        "config": {
+            "batch_size": B,
+            "steps_per_call": K,
+            "capacity": C,
+            "sampler": "two_level",
+            "second_moment_dtype": "bfloat16",
+            "target_dtype": "bfloat16",
+            "chip": jax.devices()[0].device_kind,
+        },
+        "note": (
+            "honest forcing via host transfer; r01's 7337.8 used "
+            "block_until_ready which is a no-op on this platform"
+        ),
+    }
+    if not args.skip_sampler_validation:
+        extra["samplers_2m"] = _validate_samplers(rng)
+
     print(
         json.dumps(
             {
@@ -82,6 +212,7 @@ def main() -> None:
                 "value": round(rate, 1),
                 "unit": "steps/s",
                 "vs_baseline": round(rate / NORTH_STAR_PER_CHIP, 3),
+                **extra,
             }
         )
     )

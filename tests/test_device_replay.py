@@ -12,7 +12,12 @@ from ape_x_dqn_tpu.learner.train_step import (
     make_optimizer,
 )
 from ape_x_dqn_tpu.models.dueling import DuelingMLP
-from ape_x_dqn_tpu.ops.pallas.sampling import _pallas_sample, _xla_sample
+from ape_x_dqn_tpu.ops.pallas.sampling import (
+    _pallas_sample,
+    _two_level_sample,
+    _xla_sample,
+    sample_indices,
+)
 from ape_x_dqn_tpu.replay.device import (
     build_fused_learn_step,
     device_replay_add,
@@ -55,7 +60,54 @@ class TestPallasSampling:
         assert list(np.asarray(out)) == [4000, 4999, 4999]
 
 
+class TestTwoLevelSampling:
+    """The default sampler: radix-√C two-level inverse-CDF (the TPU-native
+    sum-tree).  Integer masses make float32 prefix sums exact, so parity
+    with the flat-cumsum oracle is bit-exact here."""
+
+    def test_matches_xla_oracle(self, rng):
+        pri = jnp.asarray(rng.integers(1, 100, 5000).astype(np.float32))
+        total = float(pri.sum())
+        targets = jnp.asarray(
+            np.sort(rng.random(64)).astype(np.float32) * total * 0.999
+        )
+        a = _xla_sample(pri, targets)
+        b = _two_level_sample(pri, targets, chunk=256)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_non_divisible_length_padded(self, rng):
+        pri = jnp.asarray(rng.integers(1, 10, 777).astype(np.float32))
+        total = float(pri.sum())
+        targets = jnp.asarray((rng.random(32) * total * 0.999).astype(np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(_xla_sample(pri, targets)),
+            np.asarray(_two_level_sample(pri, targets, chunk=64)),
+        )
+
+    def test_zero_mass_rows_skipped(self):
+        pri = np.zeros(1024, np.float32)
+        pri[700] = 1.0
+        pri[1023] = 3.0
+        targets = jnp.asarray([0.5, 1.5, 3.9], jnp.float32)
+        out = _two_level_sample(jnp.asarray(pri), targets, chunk=128)
+        assert list(np.asarray(out)) == [700, 1023, 1023]
+
+    def test_default_dispatch_is_two_level(self, rng):
+        pri = jnp.asarray(rng.integers(1, 50, 2048).astype(np.float32))
+        total = float(pri.sum())
+        targets = jnp.asarray((rng.random(16) * total * 0.999).astype(np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(sample_indices(pri, targets)),
+            np.asarray(_two_level_sample(pri, targets)),
+        )
+
+
 class TestDeviceReplay:
+    def test_add_rejects_chunk_wider_than_capacity(self):
+        st = init_device_replay(8, (8,))
+        with pytest.raises(ValueError, match="exceeds replay capacity"):
+            device_replay_add(st, make_chunk(9), jnp.ones(9))
+
     def test_add_ring_semantics(self):
         st = init_device_replay(8, (8,))
         st = device_replay_add(st, make_chunk(6), jnp.ones(6))
@@ -139,6 +191,85 @@ class TestFusedLearnStep:
         # Priorities were restamped: mass no longer all equal.
         mass = np.asarray(r2.mass)[:96]
         assert mass.std() > 0
+
+    def test_hoisted_target_sync_crossing(self):
+        """With sync hoisted (sync_in_step=False + target_sync_freq=K·m),
+        target params stay fixed until the scan crosses a freq multiple,
+        then equal the online params at the call boundary."""
+        net = DuelingMLP(num_actions=3, hidden_sizes=(16,))
+        opt = make_optimizer("adam", learning_rate=1e-2)
+        tstate = init_train_state(net, opt, jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 8), jnp.uint8))
+        rstate = init_device_replay(128, (8,))
+        rstate = device_replay_add(rstate, make_chunk(64), jnp.ones(64))
+        base = build_train_step(net, opt, sync_in_step=False, jit=False)
+        fused = build_fused_learn_step(
+            base, batch_size=16, steps_per_call=4, target_sync_freq=8,
+        )
+        t0_target = jax.tree_util.tree_leaves(tstate.target_params)[0].copy()
+        # Call 1: step 0→4, no multiple of 8 crossed → target unchanged.
+        tstate, rstate, _ = fused(tstate, rstate, make_chunk(8, seed=1),
+                                  jnp.ones(8), 0.4, jax.random.PRNGKey(1))
+        leaf = jax.tree_util.tree_leaves(tstate.target_params)[0]
+        np.testing.assert_array_equal(np.asarray(leaf), np.asarray(t0_target))
+        # Call 2: step 4→8 crosses 8 → target == online exactly.
+        tstate, rstate, _ = fused(tstate, rstate, make_chunk(8, seed=2),
+                                  jnp.ones(8), 0.4, jax.random.PRNGKey(2))
+        for on, tg in zip(
+            jax.tree_util.tree_leaves(tstate.params),
+            jax.tree_util.tree_leaves(tstate.target_params),
+        ):
+            np.testing.assert_array_equal(np.asarray(on), np.asarray(tg))
+
+    def test_include_ingest_false_signature(self):
+        net = DuelingMLP(num_actions=3, hidden_sizes=(16,))
+        opt = make_optimizer("adam", learning_rate=1e-3)
+        tstate = init_train_state(net, opt, jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 8), jnp.uint8))
+        rstate = init_device_replay(128, (8,))
+        rstate = device_replay_add(rstate, make_chunk(64), jnp.ones(64))
+        base = build_train_step(net, opt, sync_in_step=False, jit=False)
+        fused = build_fused_learn_step(
+            base, batch_size=16, steps_per_call=3, include_ingest=False,
+        )
+        t2, r2, metrics = fused(tstate, rstate, 0.4, jax.random.PRNGKey(1))
+        assert int(t2.step) == 3
+        assert int(r2.count) == 64  # no ingest happened
+        assert metrics.loss.shape == (3,)
+
+    def test_bf16_knobs_still_learn(self):
+        """The HBM-traffic knobs (bf16 second moment, bf16 target) must not
+        break optimization: constant-target regression loss still falls."""
+        net = DuelingMLP(num_actions=3, hidden_sizes=(32,))
+        opt = make_optimizer(
+            "rmsprop", learning_rate=3e-3, max_grad_norm=None,
+            second_moment_dtype=jnp.bfloat16,
+        )
+        tstate = init_train_state(
+            net, opt, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.uint8),
+            target_dtype=jnp.bfloat16,
+        )
+        tgt_leaf = jax.tree_util.tree_leaves(tstate.target_params)[0]
+        assert tgt_leaf.dtype == jnp.bfloat16
+        rstate = init_device_replay(512, (8,))
+        base = build_train_step(net, opt, sync_in_step=False, jit=False)
+        fused = build_fused_learn_step(base, batch_size=32, steps_per_call=8,
+                                       target_sync_freq=64)
+        r = np.random.default_rng(0)
+        losses = []
+        for it in range(12):
+            chunk = NStepTransition(
+                obs=jnp.asarray(r.integers(0, 255, (32, 8), dtype=np.uint8)),
+                action=jnp.asarray(r.integers(0, 3, (32,), dtype=np.int32)),
+                reward=jnp.ones((32,), jnp.float32),
+                discount=jnp.zeros((32,), jnp.float32),
+                next_obs=jnp.asarray(r.integers(0, 255, (32, 8), dtype=np.uint8)),
+            )
+            tstate, rstate, metrics = fused(
+                tstate, rstate, chunk, jnp.ones(32), 0.4, jax.random.PRNGKey(it)
+            )
+            losses.append(float(np.asarray(metrics.loss)[-1]))
+        assert losses[-1] < losses[0] * 0.5, losses
 
     def test_fused_loop_learns(self):
         """Constant-target regression through the fused path: loss falls."""
