@@ -11,7 +11,6 @@ from ape_x_dqn_tpu.types import (
     NStepTransition,
     PrioritizedBatch,
     TrainState,
-    Transition,
 )
 
 __version__ = "0.1.0"
@@ -20,6 +19,5 @@ __all__ = [
     "NStepTransition",
     "PrioritizedBatch",
     "TrainState",
-    "Transition",
     "__version__",
 ]

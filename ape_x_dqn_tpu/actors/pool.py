@@ -22,9 +22,12 @@ parts #3).  The TPU-native inversion implemented here:
     during action selection** — no second forward pass.
   * Episode boundaries: per-step discount γ·(1−done) folds terminal masking
     into the return math (defect fixed vs. reference, SURVEY §2.8).
-    Truncation (time limits) is treated as termination for the window math —
-    the standard DQN simplification; the env layer still reports both so
-    metrics distinguish them.
+    Truncation (time limits) keeps its bootstrap, per the env contract
+    (envs/core.py:24-28): the truncation step's reward absorbs
+    γ·max_a Q(S_final) — one extra batched forward on the episode's final
+    observation, only on steps where a truncation happened — and the
+    discount then zeroes like a terminal, so no window ever crosses an
+    episode boundary into the next episode's states.
 
 Parameter sync mirrors reference actor.py:189-191 (poll every
 ``sync_every`` fleet steps) against a ``ParamSource`` — any object with a
@@ -227,10 +230,26 @@ class ActorFleet:
             vs = self.envs.step(actions)
             done = vs.terminated | vs.truncated
             discount = (self.gamma * (1.0 - done)).astype(np.float32)
+            reward = vs.reward
+            trunc = vs.truncated & ~vs.terminated
+            if trunc.any():
+                # Truncation bootstrap: the final observation never feeds the
+                # policy (next input is reset_obs), so run one extra batched
+                # forward on it and bake γ·max_a Q(S_final) into this step's
+                # reward.  Windows then stop at the boundary (discount 0)
+                # with the tail value already inside the return — the env
+                # contract's "bootstrap survives" (envs/core.py:24-28).
+                _, q_final = self._policy_step(
+                    self.params, vs.obs, self._epsilons, self._step_count
+                )
+                boot = np.asarray(q_final).max(axis=-1)
+                reward = reward + np.where(
+                    trunc, self.gamma * boot, 0.0
+                ).astype(np.float32)
             self._roll_in(
                 self._obs,
                 actions,
-                vs.reward,
+                reward,
                 discount,
                 q.max(axis=-1),
                 np.take_along_axis(q, actions[:, None], axis=-1)[:, 0],

@@ -3,6 +3,7 @@
 ``make_env`` is the config-string factory the rest of the framework uses:
   * ``"chain:N"``   — N-state ChainMDP (learning tests)
   * ``"catch"``     — bsuite-style Catch (pixel learning tests)
+  * ``"loop:T"``    — single-state truncation-only env (bootstrap tests)
   * ``"random"`` / ``"random:HxWxC"`` — RandomFrameEnv (throughput benches)
   * anything else   — the full Atari preprocessing stack via gymnasium
     (reference env.py:3-4's ``gym.make``, plus the wrappers it lacked).
@@ -20,7 +21,14 @@ from ape_x_dqn_tpu.envs.atari import (
     make_atari_env,
     make_local_env,
 )
-from ape_x_dqn_tpu.envs.core import CatchEnv, ChainMDP, Env, RandomFrameEnv, StepResult
+from ape_x_dqn_tpu.envs.core import (
+    CatchEnv,
+    ChainMDP,
+    Env,
+    LoopEnv,
+    RandomFrameEnv,
+    StepResult,
+)
 from ape_x_dqn_tpu.envs.vector import SyncVectorEnv, VectorStep
 
 
@@ -31,6 +39,9 @@ def make_env(spec: str, seed: int = 0, **atari_kwargs) -> Env:
         return ChainMDP(n_states=n)
     if spec == "catch":
         return CatchEnv(seed=seed)
+    if spec.startswith("loop"):
+        t = int(spec.split(":")[1]) if ":" in spec else 10
+        return LoopEnv(time_limit=t)
     if spec.startswith("random"):
         if ":" in spec:
             dims = tuple(int(d) for d in spec.split(":")[1].split("x"))
@@ -45,6 +56,7 @@ __all__ = [
     "ChainMDP",
     "Env",
     "EpisodicLife",
+    "LoopEnv",
     "FrameSkip",
     "FrameStack",
     "GymnasiumEnv",

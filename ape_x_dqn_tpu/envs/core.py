@@ -125,6 +125,35 @@ class CatchEnv:
         return StepResult(self._obs(), 0.0, False, False)
 
 
+class LoopEnv:
+    """Single-state env paying +1 per step, ending only by time-limit
+    truncation — the sharpest probe of truncation bootstrapping.
+
+    The true value under "bootstrap survives truncation" (envs/core.py
+    contract) is the infinite-horizon fixed point V = 1/(1−γ); collapsing
+    truncation into termination instead drives Q toward the average
+    *remaining-horizon* return E[(1−γ^(T−t))/(1−γ)], far below it.  A test
+    can therefore assert the unbiased fixed point to detect the collapse.
+    """
+
+    def __init__(self, time_limit: int = 10):
+        self.time_limit = int(time_limit)
+        self.observation_shape = (4,)
+        self.num_actions = 2
+        self._t = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.full(4, 255, np.uint8)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        self._t = 0
+        return self._obs()
+
+    def step(self, action: int) -> StepResult:
+        self._t += 1
+        return StepResult(self._obs(), 1.0, False, self._t >= self.time_limit)
+
+
 class RandomFrameEnv:
     """Throughput/bench env: random uint8 frames, fixed-length episodes, no
     dynamics.  Stands in for Atari when ALE isn't installed (this image), so

@@ -108,7 +108,14 @@ def device_replay_sample(
     targets = (jnp.arange(batch_size, dtype=jnp.float32) + u) * bounds
     targets = jnp.minimum(targets, total * (1.0 - 1e-7))
     idx = sample_indices(state.mass, targets)
-    size = jnp.minimum(state.count, state.capacity).astype(jnp.float32)
+    # The ring fills [0, size) before wrapping, so every slot below ``size``
+    # carries nonzero mass (add/update floor priorities at 1e-12).  Clamp:
+    # float32 accumulation drift can resolve a target one-past-the-end into
+    # an empty slot whose ~0 prob would then dominate the IS-weight
+    # normalization (round-2 advisor finding).
+    size_i = jnp.maximum(jnp.minimum(state.count, state.capacity), 1)
+    idx = jnp.minimum(idx, size_i - 1)
+    size = size_i.astype(jnp.float32)
     probs = state.mass[idx] / jnp.maximum(total, 1e-12)
     weights = jnp.power(jnp.maximum(size * probs, 1e-12), -beta)
     weights = weights / jnp.max(weights)
@@ -123,6 +130,85 @@ def device_replay_sample(
         indices=idx,
         is_weights=weights.astype(jnp.float32),
     )
+
+
+def device_replay_sample_many(
+    state: DeviceReplayState,
+    rng: jax.Array,
+    num_batches: int,
+    batch_size: int,
+    beta: jax.Array | float = 0.4,
+) -> PrioritizedBatch:
+    """Sample K stratified batches from the *current* priorities in one
+    batched inverse-CDF call + one row gather (leaves get leading [K, B]).
+
+    The per-step spelling costs ~95 µs/step at B=32 on a v5e — almost all
+    fixed op overhead, not bandwidth (PROFILE.md) — because a 32-row sample
+    launches ~15 tiny ops.  Batching all K batches into one call amortizes
+    that overhead K-fold.  The trade: batches 2..K are drawn from priorities
+    as of call entry rather than after each preceding step's restamp — K
+    steps of staleness, the same order the async Ape-X pipeline already
+    tolerates between actor-priority computation and learner restamp
+    (reference's actors/learner run fully desynchronized).
+    """
+    K, B = num_batches, batch_size
+    total = jnp.sum(state.mass)
+    bounds = total / B
+    u = jax.random.uniform(rng, (K, B))
+    targets = (jnp.arange(B, dtype=jnp.float32)[None, :] + u) * bounds
+    targets = jnp.minimum(targets, total * (1.0 - 1e-7))
+    idx = sample_indices(state.mass, targets.reshape(-1))      # [K*B]
+    size_i = jnp.maximum(jnp.minimum(state.count, state.capacity), 1)
+    idx = jnp.minimum(idx, size_i - 1)  # zero-mass guard (see sample above)
+    probs = state.mass[idx] / jnp.maximum(total, 1e-12)
+    weights = jnp.power(
+        jnp.maximum(size_i.astype(jnp.float32) * probs, 1e-12), -beta
+    ).reshape(K, B)
+    weights = weights / jnp.max(weights, axis=1, keepdims=True)
+    idx2 = idx.reshape(K, B)
+    return PrioritizedBatch(
+        transition=NStepTransition(
+            obs=state.obs[idx].reshape(K, B, *state.obs.shape[1:]),
+            action=state.action[idx2],
+            reward=state.reward[idx2],
+            discount=state.discount[idx2],
+            next_obs=state.next_obs[idx].reshape(K, B, *state.next_obs.shape[1:]),
+        ),
+        indices=idx2,
+        is_weights=weights.astype(jnp.float32),
+    )
+
+
+def device_replay_restamp_last(
+    state: DeviceReplayState,
+    indices: jax.Array,     # int32 [K, B] in step order
+    priorities: jax.Array,  # float32 [K, B]
+    priority_exponent: float = 0.6,
+) -> DeviceReplayState:
+    """Batched priority restamp with sequential (last-wins) semantics.
+
+    A slot sampled by several of the K batches must end with the *latest*
+    step's priority — what K in-scan scatters would produce.  XLA scatter
+    leaves duplicate-index write order unspecified, so resolve duplicates
+    first: stable-sort by slot (ties keep step order), keep only each run's
+    last element, and route the rest to a dummy slot that is sliced off.
+    One sort + one scatter replaces K 32-element scatters (~15 µs/step of
+    pure op overhead, PROFILE.md).
+    """
+    idx = indices.reshape(-1)
+    mass = jnp.power(
+        jnp.maximum(priorities.astype(jnp.float32).reshape(-1), 1e-12),
+        priority_exponent,
+    )
+    order = jnp.argsort(idx, stable=True)
+    si, sm = idx[order], mass[order]
+    is_last = jnp.concatenate(
+        [si[1:] != si[:-1], jnp.ones((1,), bool)]
+    )
+    target = jnp.where(is_last, si, state.capacity)  # dummy slot C
+    ext = jnp.concatenate([state.mass, jnp.zeros((1,), jnp.float32)])
+    ext = ext.at[target].set(sm)
+    return state.replace(mass=ext[:-1])
 
 
 def device_replay_update_priorities(
@@ -143,6 +229,7 @@ def build_fused_learn_step(
     priority_exponent: float = 0.6,
     target_sync_freq: int | None = 2500,
     include_ingest: bool = True,
+    sample_ahead: bool = False,
     jit: bool = True,
 ):
     """Fuse [ingest chunk] → scan_K [sample → train → restamp] into one
@@ -170,6 +257,13 @@ def build_fused_learn_step(
         False the signature drops ``chunk``/``chunk_priorities`` and the
         caller ingests at its own cadence via ``device_replay_add`` — the
         async runtime's shape, where actor chunks arrive on their own clock.
+      sample_ahead: with True, all K batches are sampled + gathered in ONE
+        batched call from call-entry priorities and restamps are applied as
+        one batched last-wins scatter after the scan — ~95 µs/step of fixed
+        op overhead drops to ~µs (PROFILE.md).  Batches 2..K see priorities
+        up to K steps stale (see ``device_replay_sample_many``); with False,
+        each scan step samples/restamps against live priorities (the strict
+        sequential-PER mode, also the test oracle for this one).
 
     Returns ``fn(train_state, replay_state, chunk, chunk_priorities, beta,
     rng) -> (train_state, replay_state, metrics)`` (without the chunk args
@@ -184,19 +278,35 @@ def build_fused_learn_step(
                 replay_state, chunk, chunk_priorities, priority_exponent
             )
 
-        def body(carry, step_rng):
-            t_state, r_state = carry
-            batch = device_replay_sample(r_state, step_rng, batch_size, beta)
-            t_state, metrics = train_step_fn(t_state, batch)
-            r_state = device_replay_update_priorities(
-                r_state, batch.indices, metrics.priorities, priority_exponent
+        if sample_ahead:
+            batches = device_replay_sample_many(
+                replay_state, rng, steps_per_call, batch_size, beta
             )
-            return (t_state, r_state), metrics
 
-        rngs = jax.random.split(rng, steps_per_call)
-        (train_state, replay_state), metrics = jax.lax.scan(
-            body, (train_state, replay_state), rngs
-        )
+            def body_pre(t_state, batch):
+                t_state, metrics = train_step_fn(t_state, batch)
+                return t_state, metrics
+
+            train_state, metrics = jax.lax.scan(body_pre, train_state, batches)
+            replay_state = device_replay_restamp_last(
+                replay_state, batches.indices, metrics.priorities,
+                priority_exponent,
+            )
+        else:
+
+            def body(carry, step_rng):
+                t_state, r_state = carry
+                batch = device_replay_sample(r_state, step_rng, batch_size, beta)
+                t_state, metrics = train_step_fn(t_state, batch)
+                r_state = device_replay_update_priorities(
+                    r_state, batch.indices, metrics.priorities, priority_exponent
+                )
+                return (t_state, r_state), metrics
+
+            rngs = jax.random.split(rng, steps_per_call)
+            (train_state, replay_state), metrics = jax.lax.scan(
+                body, (train_state, replay_state), rngs
+            )
         if target_sync_freq is not None:
             crossed = (train_state.step // target_sync_freq) > (
                 step_before // target_sync_freq

@@ -29,11 +29,21 @@ _lock = threading.Lock()
 
 
 def _build() -> None:
+    # Compile to a private temp file, then atomically rename over the .so:
+    # two processes racing on first use must never dlopen a half-written
+    # artifact (rename is atomic within a directory on POSIX), and a failed
+    # compile must not leave a bad .so that poisons every later run.
+    tmp = f"{_SO}.tmp.{os.getpid()}"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-        "-o", _SO, _SRC,
+        "-o", tmp, _SRC,
     ]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.rename(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def _load():

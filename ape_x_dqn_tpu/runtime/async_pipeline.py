@@ -88,15 +88,23 @@ class _ActorWorker:
         return out
 
     def _supervise(self):
+        # Cumulative fleet steps across incarnations: actor.T bounds TOTAL
+        # env steps, so a respawned fleet only gets the remaining budget
+        # (round-1 advisor finding: a fresh step_count per incarnation let
+        # crashy fleets exceed T).
+        steps_done = 0
         while not self._stop.is_set():
+            fleet = None
             try:
                 fleet = self._comps.make_fleet(seed_offset=self.restarts)
                 fleet.sync_params(self._store)
-                self._run_fleet(fleet)
+                self._run_fleet(fleet, self._comps.cfg.actor.T - steps_done)
                 # Distinguish "actor.T exhausted" from "told to stop".
                 self.finished = not self._stop.is_set()
                 return  # clean stop
             except Exception as e:
+                if fleet is not None:
+                    steps_done += fleet.step_count
                 self.restarts += 1
                 self._logger.log("actor/restarts", self.restarts)
                 if self.restarts > self._max_restarts:
@@ -105,8 +113,7 @@ class _ActorWorker:
                     return
                 time.sleep(0.1)
 
-    def _run_fleet(self, fleet):
-        max_steps = self._comps.cfg.actor.T
+    def _run_fleet(self, fleet, max_steps: int):
         while not self._stop.is_set() and fleet.step_count < max_steps:
             chunks, stats = fleet.collect(self._quantum, param_source=self._store)
             for chunk in chunks:
@@ -258,10 +265,14 @@ class AsyncPipeline:
         self.worker.start()
         last_metrics = None
         try:
+            # Drain partial blocks once the actors are done — otherwise a
+            # tail of < ingest_block staged rows can strand warmup below the
+            # threshold even though enough transitions were collected
+            # (round-2 advisor finding).
             self._wait_for_warmup(
                 warmup_timeout,
                 size_fn=lambda: fused.size,
-                tick=fused.ingest_staged,
+                tick=lambda: fused.ingest_staged(drain=self.worker.finished),
             )
             next_log = self._learner_step + self.log_every
             next_ckpt = (
@@ -270,7 +281,7 @@ class AsyncPipeline:
                 else None
             )
             while self._learner_step < target and not self.stop_event.is_set():
-                fused.ingest_staged()
+                fused.ingest_staged(drain=self.worker.finished)
                 beta = beta_schedule(
                     self._learner_step, cfg.learner.total_steps,
                     cfg.replay.is_exponent,

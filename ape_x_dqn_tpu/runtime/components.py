@@ -73,6 +73,13 @@ class Components:
         from ape_x_dqn_tpu.runtime.fused_learner import FusedDeviceLearner
 
         cfg = self.cfg
+        # The fused scan syncs targets at call boundaries, exact only when
+        # freq % K == 0 — round the freq down to a multiple of K (never
+        # below K) so the default config (2500, K=128) syncs exactly rather
+        # than up to K-1 steps late.
+        K = cfg.learner.steps_per_call
+        freq = cfg.learner.q_target_sync_freq
+        freq = max(K, freq - freq % K)
         return FusedDeviceLearner(
             self.network,
             self.optimizer,
@@ -80,9 +87,9 @@ class Components:
             self.obs_shape,
             capacity=cfg.replay.capacity,
             batch_size=cfg.learner.replay_sample_size,
-            steps_per_call=cfg.learner.steps_per_call,
+            steps_per_call=K,
             priority_exponent=cfg.replay.priority_exponent,
-            target_sync_freq=cfg.learner.q_target_sync_freq,
+            target_sync_freq=freq,
             loss_kind=cfg.learner.loss,
         )
 

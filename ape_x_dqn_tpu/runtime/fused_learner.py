@@ -80,7 +80,10 @@ class FusedDeviceLearner:
             lambda r, t, p: device_replay_add(r, t, p, priority_exponent),
             donate_argnums=(0,),
         )
-        self._rng = jax.random.PRNGKey(int(np.asarray(state.rng)[0]))
+        # Distinct per-seed sampling stream: fold a salt into the state's key
+        # (reading a key word breaks — the high word is 0 for seeds < 2^32,
+        # which made every seed sample identically; round-2 advisor finding).
+        self._rng = jax.random.fold_in(state.rng, 0x5EED)
         # Host staging: numpy transitions accumulate here until a full
         # fixed-size block exists (static shapes → one compiled ingest).
         self._lock = threading.Lock()

@@ -4,7 +4,9 @@ The reference duplicates two namedtuples (``Transition``/``N_Step_Transition``)
 by copy-paste across three files (reference: actor.py:11-12, learner.py:8,
 replay.py:5).  Here the wire format is a single set of ``flax.struct`` pytrees
 shared by every subsystem, so they move through ``jit``/``pjit`` and across
-host threads without conversion.
+host threads without conversion.  There is deliberately no 1-step transition
+type: the actor pool composes n-step windows from its history ring and only
+``NStepTransition`` ever crosses a subsystem boundary.
 
 Design notes (TPU-first):
   * Observations are stored ``uint8`` end-to-end and cast to compute dtype
@@ -27,23 +29,6 @@ from flax import struct
 
 Array = jax.Array
 PyTree = Any
-
-
-@struct.dataclass
-class Transition:
-    """One environment step (the reference's 1-step ``Transition``).
-
-    Fields mirror reference actor.py:11 ``Transition(S, A, R, Gamma, q)``,
-    with the q-values kept for actor-side priority computation and an explicit
-    terminal flag the reference lacks (defect register SURVEY §2.8: the
-    reference bootstraps through episode ends).
-    """
-
-    obs: Array          # uint8 [*obs_shape]
-    action: Array       # int32 []
-    reward: Array       # float32 []
-    discount: Array     # float32 [] — gamma * (1 - terminal)
-    q_values: Array     # float32 [num_actions] — online-net values at obs
 
 
 @struct.dataclass

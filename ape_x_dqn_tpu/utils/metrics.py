@@ -15,7 +15,7 @@ import json
 import sys
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import IO, Dict, Optional
 
 
@@ -24,7 +24,7 @@ class RateCounter:
 
     def __init__(self, window_s: float = 10.0):
         self._window = window_s
-        self._events: list[tuple[float, float]] = []  # (time, count)
+        self._events: deque[tuple[float, float]] = deque()  # (time, count)
         self._total = 0.0
         self._lock = threading.Lock()
 
@@ -35,7 +35,7 @@ class RateCounter:
             self._total += n
             cutoff = now - self._window
             while self._events and self._events[0][0] < cutoff:
-                self._events.pop(0)
+                self._events.popleft()
 
     @property
     def total(self) -> float:
@@ -47,7 +47,7 @@ class RateCounter:
         with self._lock:
             cutoff = now - self._window
             while self._events and self._events[0][0] < cutoff:
-                self._events.pop(0)
+                self._events.popleft()
             if not self._events:
                 return 0.0
             span = max(now - self._events[0][0], 1e-9)

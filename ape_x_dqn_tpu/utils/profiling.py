@@ -1,0 +1,190 @@
+"""Tracing/profiling subsystem (SURVEY §5: absent in the reference).
+
+Three tools, smallest-first:
+
+  * ``StageTimer`` — per-component wall-clock accumulators for the host-side
+    pipeline stages (sample / place / step / write-back / ingest).  The
+    north-star metrics are throughputs, so per-stage µs/step is the first
+    derivative every perf investigation needs; the async runtime exports
+    these in its JSONL metrics.
+  * ``trace(logdir)`` — context manager around ``jax.profiler`` device
+    tracing (TensorBoard-viewable).  Gated: on platforms where the plugin
+    can't trace (the tunneled axon TPU), it degrades to a no-op with a
+    warning instead of crashing the run.
+  * ``subtractive_timing`` — the measurement pattern that actually works on
+    this platform (per-op traces don't cross the tunnel): time K-step fused
+    program *variants* with stages deleted; the difference isolates each
+    stage's device cost.  Used by ``bench.py --profile`` to produce
+    PROFILE.md.
+
+The reference has no profiling at all (``time`` is imported in its
+learner.py:3 solely for ``sleep`` — reference SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Callable, Dict, Iterator, Optional
+
+
+class StageTimer:
+    """Named wall-clock accumulators: ``with timer.stage("sample"): ...``.
+
+    Cheap enough for hot loops (one ``perf_counter`` pair per section) and
+    thread-compatible by virtue of only using per-call locals plus atomic
+    dict updates under CPython.
+    """
+
+    def __init__(self):
+        self._total_s: Dict[str, float] = defaultdict(float)
+        self._count: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._total_s[name] += dt
+            self._count[name] += 1
+
+    def add(self, name: str, seconds: float) -> None:
+        self._total_s[name] += seconds
+        self._count[name] += 1
+
+    def us_per_call(self) -> Dict[str, float]:
+        return {
+            name: round(self._total_s[name] / max(1, self._count[name]) * 1e6, 1)
+            for name in self._total_s
+        }
+
+    def snapshot(self) -> Dict[str, dict]:
+        return {
+            name: {
+                "total_s": round(self._total_s[name], 4),
+                "calls": self._count[name],
+                "us_per_call": round(
+                    self._total_s[name] / max(1, self._count[name]) * 1e6, 1
+                ),
+            }
+            for name in self._total_s
+        }
+
+    def reset(self) -> None:
+        self._total_s.clear()
+        self._count.clear()
+
+
+@contextlib.contextmanager
+def trace(logdir: str, enabled: bool = True) -> Iterator[bool]:
+    """``jax.profiler`` device trace into ``logdir`` (TensorBoard format).
+
+    Yields True if tracing actually started.  Platforms whose profiler
+    plugin can't trace (tunneled devices) degrade to a no-op — profiling
+    must never kill a training run.
+    """
+    if not enabled:
+        yield False
+        return
+    import jax
+
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception as e:  # plugin unavailable on this platform
+        print(f"WARNING: jax.profiler trace unavailable ({e}); continuing")
+        started = False
+    try:
+        yield started
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                print(f"WARNING: jax.profiler stop_trace failed ({e})")
+
+
+def start_server(port: int = 9999) -> Optional[object]:
+    """Start the live profiler server (``tensorboard --logdir`` can attach).
+    Returns the server handle or None if unsupported here."""
+    import jax
+
+    try:
+        return jax.profiler.start_server(port)
+    except Exception as e:
+        print(f"WARNING: jax.profiler server unavailable ({e})")
+        return None
+
+
+def subtractive_timing(
+    variants: Dict[str, Callable[[], None]],
+    force: Callable[[], None],
+    warmup: int = 2,
+    repeats: int = 3,
+) -> Dict[str, float]:
+    """Time each no-arg variant (already closed over its inputs), forcing
+    completion via ``force`` (a host transfer — ``block_until_ready`` is a
+    no-op on the tunneled platform, bench.py methodology note).
+
+    Returns {name: seconds} of the best (min) of ``repeats`` runs — min is
+    the right estimator for device work measured through a noisy host.
+
+    NB: each force pays the tunnel's fixed post-sync dispatch cost (~140 ms
+    measured) — fine for multi-second workloads, hopeless for µs-scale ones;
+    use ``slope_timing`` for those.
+    """
+    out: Dict[str, float] = {}
+    for name, fn in variants.items():
+        for _ in range(warmup):
+            fn()
+        force()
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            fn()
+            force()
+            best = min(best, time.perf_counter() - t0)
+        out[name] = best
+    return out
+
+
+def slope_timing(
+    variants: Dict[str, Callable[[], None]],
+    force: Callable[[], None],
+    n_small: int = 2,
+    n_big: int = 10,
+    repeats: int = 3,
+) -> Dict[str, float]:
+    """Marginal per-call device time via a two-point linear fit.
+
+    On the tunneled platform the first dispatch after any host sync costs a
+    fixed ~140 ms while back-to-back enqueues are nearly free, so wall time
+    of n chained calls is  T(n) ≈ fixed + n·device — the slope
+    (T(n_big) − T(n_small)) / (n_big − n_small) cancels the fixed term and
+    measures pure per-call device time.  Calls must be chained (each
+    consuming the previous call's outputs) so the device can't overlap them.
+
+    Returns {name: seconds per call}, min over ``repeats`` slope estimates.
+    """
+    out: Dict[str, float] = {}
+    for name, fn in variants.items():
+        fn()
+        force()  # compile + steady state
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(n_small):
+                fn()
+            force()
+            t1 = time.perf_counter()
+            for _ in range(n_big):
+                fn()
+            force()
+            t2 = time.perf_counter()
+            slope = ((t2 - t1) - (t1 - t0)) / (n_big - n_small)
+            best = min(best, slope)
+        out[name] = max(best, 0.0)
+    return out

@@ -6,7 +6,7 @@ import jax
 import pytest
 
 from ape_x_dqn_tpu.actors import ActorFleet, LocalParamSource
-from ape_x_dqn_tpu.envs import ChainMDP, RandomFrameEnv
+from ape_x_dqn_tpu.envs import ChainMDP, LoopEnv, RandomFrameEnv
 from ape_x_dqn_tpu.models.dueling import DuelingMLP
 from ape_x_dqn_tpu.ops.nstep import nstep_returns_np, nstep_returns_reference
 
@@ -70,6 +70,56 @@ def test_discount_zero_at_terminals():
     assert (disc == 0.0).any(), "terminals should zero some bootstrap discounts"
     assert len(stats) > 0
     assert all(1 <= s.episode_length <= 20 for s in stats)
+
+
+def test_truncation_bootstrap_folds_q_into_reward():
+    """Truncated steps keep their bootstrap (envs/core.py contract): the
+    emitted reward at a truncation step must be r + γ·max_a Q(S_final) and
+    its discount 0, while ordinary steps carry the raw reward and γ."""
+    gamma = 0.9
+    net = DuelingMLP(num_actions=2, hidden_sizes=(16,))
+    fleet = ActorFleet(
+        [lambda: LoopEnv(time_limit=5)] * 2,
+        net,
+        n_step=1,
+        flush_every=5,
+        gamma=gamma,
+    )
+    params = net.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.uint8))
+    fleet.sync_params(LocalParamSource(params))
+    chunks, stats = fleet.collect(31)
+    rewards = np.concatenate([c.transitions.reward for c in chunks])
+    discounts = np.concatenate([c.transitions.discount for c in chunks])
+    qmax = float(
+        np.asarray(net.apply(params, np.full((1, 4), 255, np.uint8))[2]).max()
+    )
+    trunc = discounts == 0.0
+    assert trunc.any() and (~trunc).any()
+    np.testing.assert_allclose(rewards[~trunc], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(rewards[trunc], 1.0 + gamma * qmax, rtol=1e-5)
+    # Truncated episodes still close out stats.
+    assert stats and all(s.episode_length == 5 for s in stats)
+
+
+def test_truncation_window_never_crosses_episodes():
+    """n-step windows that span a truncation must cut there (discount 0) —
+    the bootstrap is inside the reward, never from next-episode states."""
+    fleet = ActorFleet(
+        [lambda: LoopEnv(time_limit=5)],
+        DuelingMLP(num_actions=2, hidden_sizes=(8,)),
+        n_step=3,
+        flush_every=5,
+        gamma=0.9,
+    )
+    net = fleet.network
+    params = net.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.uint8))
+    fleet.sync_params(LocalParamSource(params))
+    chunks, _ = fleet.collect(40)
+    disc = np.concatenate([c.transitions.discount for c in chunks])
+    # Every window either runs n clean steps (γ^n) or hits the boundary (0).
+    uniq = np.unique(disc)
+    assert np.isclose(uniq[:, None], [0.0, 0.9**3], atol=1e-6).any(axis=1).all(), uniq
+    assert (disc == 0.0).any() and (disc > 0).any()
 
 
 def test_episode_stats_accumulate_reward():

@@ -166,6 +166,30 @@ class TestAsyncPipeline:
         assert pipe.worker.restarts == 1
         assert pipe.learner_step == 60
 
+    def test_truncation_unbiased_value_async(self):
+        """Async twin of test_truncation_unbiased_value_sync: the pipeline's
+        threaded actor path must apply the same truncation bootstrap."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = pipeline_config()
+        cfg.env.name = "loop:10"
+        cfg.actor.gamma = 0.9
+        cfg.learner.loss = "squared"
+        cfg.learner.learning_rate = 3e-3
+        cfg.learner.q_target_sync_freq = 25
+        cfg.learner.min_replay_mem_size = 200
+        pipe = AsyncPipeline(cfg, logger=MetricLogger(stream=io.StringIO()))
+        pipe.run(learner_steps=2000, warmup_timeout=120.0)
+        q = np.asarray(
+            pipe.comps.network.apply(
+                pipe.comps.state.params,
+                jnp.full((1, 4), 255, jnp.uint8),
+            )[2]
+        )
+        assert q.max() > 8.5, f"Q biased toward truncation cutoff: {q}"
+        assert q.max() < 12.0, f"Q diverged: {q}"
+
     def test_actor_permafail_raises(self):
         cfg = pipeline_config()
 
