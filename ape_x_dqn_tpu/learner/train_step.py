@@ -86,6 +86,48 @@ def _scale_by_rms_lowp(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def with_float32_master(
+    optimizer: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Mixed-precision wrapper: run ``optimizer`` against a float32 master
+    copy of the params kept inside the optimizer state, while the network's
+    own params live in bfloat16.
+
+    Why: with bfloat16 params the per-step update (~lr · normalized-grad,
+    ~6e-5) is below bfloat16's resolution at typical weight magnitudes, so
+    naive ``apply_updates`` rounds most updates to zero and learning stalls.
+    The master copy accumulates in float32; the emitted update is exactly
+    the delta that lands the low-precision params on ``cast(master)`` (the
+    add is lossless whenever params and master are within 2× of each other —
+    Sterbenz — i.e. always, for a per-step change this small).
+
+    HBM accounting (3.4M-param net, per step): forward/backward read params
+    at half width (−13 MB and the f32→bf16 cast op disappears), while the
+    optimizer carries the master r/w (+26 MB) but drops the f32 param r/w
+    (−26 MB) — net ~−20 MB/step of a ~100 MB/step bandwidth-bound program.
+    """
+
+    def init_fn(params):
+        master = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), params
+        )
+        return (master, optimizer.init(master))
+
+    def update_fn(updates, state, params):
+        master, inner = state
+        g32 = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), updates
+        )
+        upd, inner = optimizer.update(g32, inner, master)
+        new_master = optax.apply_updates(master, upd)
+        emitted = jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype) - p, new_master, params
+        )
+        return emitted, (new_master, inner)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def make_optimizer(
     kind: str = "rmsprop",
     learning_rate: float = 0.00025 / 4,

@@ -101,35 +101,12 @@ def device_replay_sample(
     batch_size: int,
     beta: jax.Array | float = 0.4,
 ) -> PrioritizedBatch:
-    """Stratified proportional sample with IS weights, fully on device."""
-    total = jnp.sum(state.mass)
-    bounds = total / batch_size
-    u = jax.random.uniform(rng, (batch_size,))
-    targets = (jnp.arange(batch_size, dtype=jnp.float32) + u) * bounds
-    targets = jnp.minimum(targets, total * (1.0 - 1e-7))
-    idx = sample_indices(state.mass, targets)
-    # The ring fills [0, size) before wrapping, so every slot below ``size``
-    # carries nonzero mass (add/update floor priorities at 1e-12).  Clamp:
-    # float32 accumulation drift can resolve a target one-past-the-end into
-    # an empty slot whose ~0 prob would then dominate the IS-weight
-    # normalization (round-2 advisor finding).
-    size_i = jnp.maximum(jnp.minimum(state.count, state.capacity), 1)
-    idx = jnp.minimum(idx, size_i - 1)
-    size = size_i.astype(jnp.float32)
-    probs = state.mass[idx] / jnp.maximum(total, 1e-12)
-    weights = jnp.power(jnp.maximum(size * probs, 1e-12), -beta)
-    weights = weights / jnp.max(weights)
-    return PrioritizedBatch(
-        transition=NStepTransition(
-            obs=state.obs[idx],
-            action=state.action[idx],
-            reward=state.reward[idx],
-            discount=state.discount[idx],
-            next_obs=state.next_obs[idx],
-        ),
-        indices=idx,
-        is_weights=weights.astype(jnp.float32),
-    )
+    """Stratified proportional sample with IS weights, fully on device.
+
+    The K=1 case of ``device_replay_sample_many`` (single implementation —
+    the strict-PER path and the sample-ahead path cannot diverge)."""
+    batch = device_replay_sample_many(state, rng, 1, batch_size, beta)
+    return jax.tree_util.tree_map(lambda a: a[0], batch)
 
 
 def device_replay_sample_many(
@@ -145,7 +122,11 @@ def device_replay_sample_many(
     The per-step spelling costs ~95 µs/step at B=32 on a v5e — almost all
     fixed op overhead, not bandwidth (PROFILE.md) — because a 32-row sample
     launches ~15 tiny ops.  Batching all K batches into one call amortizes
-    that overhead K-fold.  The trade: batches 2..K are drawn from priorities
+    that overhead K-fold.  Memory: the gather materializes all K batches —
+    K·B·2·obs_bytes of transient HBM (K=2048, B=32, 84×84×1 ≈ 0.9 GB;
+    frame-stacked 84×84×4 ≈ 3.7 GB) — so size K to the observation shape;
+    the strict path holds one batch at a time.  The trade: batches 2..K are
+    drawn from priorities
     as of call entry rather than after each preceding step's restamp — K
     steps of staleness, the same order the async Ape-X pipeline already
     tolerates between actor-priority computation and learner restamp

@@ -108,10 +108,25 @@ def _validate_samplers(rng) -> dict:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--steps-per-call", type=int, default=1024)
+    parser.add_argument("--steps-per-call", type=int, default=2048)
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--capacity", type=int, default=100_000)
     parser.add_argument("--timed-calls", type=int, default=8)
+    parser.add_argument(
+        "--strict-per", action="store_true",
+        help="sequential PER (sample/restamp every step in-scan) instead of "
+        "the batched sample-ahead mode (device_replay_sample_many)",
+    )
+    parser.add_argument(
+        "--param-dtype", default="float32", choices=("bfloat16", "float32"),
+        help="network param storage dtype (bfloat16 pairs with a float32 "
+        "master copy in the optimizer — train_step.with_float32_master). "
+        "NB: bfloat16 params currently trip a TPU backend error "
+        "(InvalidArgument) on this tunneled axon platform whenever the "
+        "fused program also holds a 100k-slot replay; the mode is fully "
+        "tested on the CPU backend (test_train_step.py) and kept for "
+        "platforms where the compiler accepts it.",
+    )
     parser.add_argument(
         "--skip-sampler-validation", action="store_true",
         help="skip the 2M-slot sampler parity check (saves ~30s)",
@@ -125,6 +140,7 @@ def main() -> None:
         build_train_step,
         init_train_state,
         make_optimizer,
+        with_float32_master,
     )
     from ape_x_dqn_tpu.models.dueling import build_network
     from ape_x_dqn_tpu.replay.device import (
@@ -137,16 +153,22 @@ def main() -> None:
     obs_shape, A, M = (84, 84, 1), 4, 256
     target_sync_freq = 2500 - 2500 % K if K <= 2500 else K  # multiple of K
 
-    net = build_network("conv", A)
+    param_dtype = jnp.bfloat16 if args.param_dtype == "bfloat16" else jnp.float32
+    net = build_network("conv", A, param_dtype=param_dtype)
     # Reference-parity RMSProp with the HBM-traffic knobs: no global-norm
-    # clip (the reference has none), bfloat16 second moment + target net
-    # (chain-MDP learning test covers this mode).
+    # clip (the reference has none), bfloat16 second moment + target net.
+    # Params default to float32: the bfloat16+f32-master mode is rejected by
+    # this platform's compiler at bench scale (see --param-dtype help and
+    # PROFILE.md).
     opt = make_optimizer(
         "rmsprop", max_grad_norm=None, second_moment_dtype=jnp.bfloat16
     )
+    if args.param_dtype == "bfloat16":
+        opt = with_float32_master(opt)
     step_fn = build_train_step(net, opt, sync_in_step=False, jit=False)
     fused = build_fused_learn_step(
-        step_fn, B, steps_per_call=K, target_sync_freq=target_sync_freq
+        step_fn, B, steps_per_call=K, target_sync_freq=target_sync_freq,
+        sample_ahead=not args.strict_per,
     )
 
     rng = np.random.default_rng(0)
@@ -193,8 +215,10 @@ def main() -> None:
             "steps_per_call": K,
             "capacity": C,
             "sampler": "two_level",
+            "sample_ahead": not args.strict_per,
             "second_moment_dtype": "bfloat16",
             "target_dtype": "bfloat16",
+            "param_dtype": args.param_dtype,
             "chip": jax.devices()[0].device_kind,
         },
         "note": (

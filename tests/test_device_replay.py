@@ -21,7 +21,9 @@ from ape_x_dqn_tpu.ops.pallas.sampling import (
 from ape_x_dqn_tpu.replay.device import (
     build_fused_learn_step,
     device_replay_add,
+    device_replay_restamp_last,
     device_replay_sample,
+    device_replay_sample_many,
     device_replay_update_priorities,
     init_device_replay,
 )
@@ -295,3 +297,92 @@ class TestFusedLearnStep:
             )
             losses.append(float(np.asarray(metrics.loss)[-1]))
         assert losses[-1] < losses[0] * 0.5, losses
+
+
+class TestSampleAhead:
+    """The batched sample-ahead spellings (device_replay_sample_many /
+    device_replay_restamp_last) behind ``sample_ahead=True``."""
+
+    def test_sample_many_shapes_and_contents(self):
+        st = init_device_replay(64, (8,))
+        chunk = make_chunk(48, seed=3)
+        st = device_replay_add(st, chunk, jnp.ones(48))
+        b = device_replay_sample_many(st, jax.random.PRNGKey(0), 5, 16)
+        assert b.indices.shape == (5, 16)
+        assert b.transition.obs.shape == (5, 16, 8)
+        assert b.is_weights.shape == (5, 16)
+        idx = np.asarray(b.indices)
+        assert (idx < 48).all()
+        np.testing.assert_array_equal(
+            np.asarray(b.transition.obs), np.asarray(chunk.obs)[idx]
+        )
+        # IS weights max-normalized per batch, not across the K axis.
+        w = np.asarray(b.is_weights)
+        np.testing.assert_allclose(w.max(axis=1), 1.0, rtol=1e-6)
+
+    def test_sample_many_proportional(self):
+        st = init_device_replay(4, (8,))
+        st = device_replay_add(
+            st, make_chunk(4), jnp.asarray([1.0, 1.0, 1.0, 100.0]),
+            priority_exponent=1.0,
+        )
+        counts = np.zeros(4)
+        for k in range(10):
+            b = device_replay_sample_many(st, jax.random.PRNGKey(k), 8, 64)
+            counts += np.bincount(np.asarray(b.indices).ravel(), minlength=4)
+        frac = counts[3] / counts.sum()
+        assert abs(frac - 100 / 103) < 0.02
+
+    def test_restamp_last_wins_matches_sequential(self):
+        """Batched restamp == K sequential scatters (last write wins)."""
+        st = init_device_replay(16, (8,))
+        st = device_replay_add(st, make_chunk(16), jnp.ones(16),
+                               priority_exponent=1.0)
+        r = np.random.default_rng(0)
+        K, B = 6, 8
+        indices = r.integers(0, 16, (K, B)).astype(np.int32)  # heavy dupes
+        prios = r.random((K, B)).astype(np.float32) + 0.1
+        seq = st
+        for k in range(K):
+            seq = device_replay_update_priorities(
+                seq, jnp.asarray(indices[k]), jnp.asarray(prios[k]),
+                priority_exponent=1.0,
+            )
+        batched = device_replay_restamp_last(
+            st, jnp.asarray(indices), jnp.asarray(prios), priority_exponent=1.0
+        )
+        np.testing.assert_allclose(
+            np.asarray(batched.mass), np.asarray(seq.mass), rtol=1e-6
+        )
+
+    def test_sample_ahead_fused_learns(self):
+        """Constant-target regression through sample_ahead=True: loss falls
+        and priorities were restamped."""
+        net = DuelingMLP(num_actions=3, hidden_sizes=(32,))
+        opt = make_optimizer("adam", learning_rate=3e-3)
+        tstate = init_train_state(net, opt, jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 8), jnp.uint8))
+        rstate = init_device_replay(512, (8,))
+        base = build_train_step(net, opt, sync_in_step=False, jit=False)
+        fused = build_fused_learn_step(
+            base, batch_size=32, steps_per_call=8, target_sync_freq=64,
+            sample_ahead=True,
+        )
+        r = np.random.default_rng(0)
+        losses = []
+        for it in range(12):
+            chunk = NStepTransition(
+                obs=jnp.asarray(r.integers(0, 255, (32, 8), dtype=np.uint8)),
+                action=jnp.asarray(r.integers(0, 3, (32,), dtype=np.int32)),
+                reward=jnp.ones((32,), jnp.float32),
+                discount=jnp.zeros((32,), jnp.float32),
+                next_obs=jnp.asarray(r.integers(0, 255, (32, 8), dtype=np.uint8)),
+            )
+            tstate, rstate, metrics = fused(
+                tstate, rstate, chunk, jnp.ones(32), 0.4, jax.random.PRNGKey(it)
+            )
+            losses.append(float(np.asarray(metrics.loss)[-1]))
+        assert int(tstate.step) == 96
+        assert losses[-1] < losses[0] * 0.5, losses
+        mass = np.asarray(rstate.mass)[:384]
+        assert mass.std() > 0  # restamp happened

@@ -102,3 +102,46 @@ def test_squared_parity_loss_mode():
     batch = _make_batch(jax.random.PRNGKey(5))
     state, m = step(state, batch)
     assert np.isfinite(float(m.loss))
+
+
+def test_bf16_params_with_f32_master_track_f32_training():
+    """param_dtype=bfloat16 + with_float32_master must track a float32 run:
+    the tiny RMSProp-scale updates (~lr) are below bf16 resolution, so
+    without the master copy they'd round to zero — with it, loss falls the
+    same way as the float32 run."""
+    from ape_x_dqn_tpu.learner.train_step import with_float32_master
+
+    def run(param_dtype, wrap):
+        net = DuelingMLP(num_actions=3, hidden_sizes=(32,),
+                         param_dtype=param_dtype)
+        opt = make_optimizer("rmsprop", learning_rate=1e-3, max_grad_norm=None)
+        if wrap:
+            opt = with_float32_master(opt)
+        state = init_train_state(net, opt, jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 6)))
+        step = build_train_step(net, opt, target_sync_freq=100, jit=False)
+        batch = _make_batch(jax.random.PRNGKey(1))
+        losses = []
+        for _ in range(60):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics.loss))
+        return state, losses
+
+    s16, l16 = run(jnp.bfloat16, wrap=True)
+    s32, l32 = run(jnp.float32, wrap=False)
+    # Params stayed bf16; master copy lives in opt state as f32.
+    leaf16 = jax.tree_util.tree_leaves(s16.params)[0]
+    assert leaf16.dtype == jnp.bfloat16
+    master_leaf = jax.tree_util.tree_leaves(s16.opt_state[0])[0]
+    assert master_leaf.dtype == jnp.float32
+    # Same descent trajectory within bf16 forward noise.
+    assert l16[-1] < l16[0] * 0.7
+    assert abs(l16[-1] - l32[-1]) < 0.25 * abs(l32[0]) + 0.05
+
+    # Low-precision params track cast(master) exactly (the Sterbenz add).
+    master = s16.opt_state[0]
+    for m, p in zip(jax.tree_util.tree_leaves(master),
+                    jax.tree_util.tree_leaves(s16.params)):
+        np.testing.assert_array_equal(
+            np.asarray(m.astype(jnp.bfloat16)), np.asarray(p)
+        )
