@@ -23,11 +23,13 @@ parts #3).  The TPU-native inversion implemented here:
   * Episode boundaries: per-step discount γ·(1−done) folds terminal masking
     into the return math (defect fixed vs. reference, SURVEY §2.8).
     Truncation (time limits) keeps its bootstrap, per the env contract
-    (envs/core.py:24-28): the truncation step's reward absorbs
-    γ·max_a Q(S_final) — one extra batched forward on the episode's final
-    observation, only on steps where a truncation happened — and the
-    discount then zeroes like a terminal, so no window ever crosses an
-    episode boundary into the next episode's states.
+    (envs/core.py:24-28): a window hitting a truncation at offset k is
+    emitted with ``next_obs = S_final`` (the episode's final observation,
+    which never feeds the policy) and ``discount = γ^(k+1)``, so the
+    LEARNER bootstraps through its live target net every time the sample
+    is replayed — the return math still stops at the boundary (no window
+    ever crosses into the next episode's states), and no stale
+    collection-time Q is ever baked into stored rewards.
 
 Parameter sync mirrors reference actor.py:189-191 (poll every
 ``sync_every`` fleet steps) against a ``ParamSource`` — any object with a
@@ -110,6 +112,8 @@ class ActorFleet:
         flush_every: int = 16,
         sync_every: int = 500,
         seed: int = 0,
+        epsilon_index_offset: int = 0,
+        epsilon_total: int | None = None,
     ):
         self.envs = SyncVectorEnv(env_fns)
         self.network = network
@@ -118,7 +122,18 @@ class ActorFleet:
         self.flush_every = int(flush_every)
         self.sync_every = int(sync_every)
         N = self.envs.num_envs
-        self._epsilons = epsilon_ladder(epsilon, epsilon_alpha, N)
+        # When this fleet is one shard of a larger actor set (process-
+        # parallel workers each own a slice), the ε-ladder spans the GLOBAL
+        # actor count and this fleet takes rows [offset, offset+N) — actor
+        # identity, and hence exploration diversity, is fleet-placement
+        # independent (reference actor.py:111-114 indexes global actor ids).
+        total = epsilon_total if epsilon_total is not None else N
+        off = int(epsilon_index_offset)
+        if off < 0 or off + N > total:
+            raise ValueError(
+                f"epsilon ladder slice [{off}, {off + N}) exceeds total {total}"
+            )
+        self._epsilons = epsilon_ladder(epsilon, epsilon_alpha, total)[off:off + N]
         self._policy_step = build_policy_step(network, seed=seed)
         self._obs = self.envs.reset(seed=seed)
         # History ring: H = flush_every + n rows; global step s lives at
@@ -132,6 +147,11 @@ class ActorFleet:
         self._hist_discount = np.zeros((H, N), np.float32)
         self._hist_qmax = np.zeros((H, N), np.float32)
         self._hist_qtaken = np.zeros((H, N), np.float32)
+        # Truncation bookkeeping: the final observation of a time-limited
+        # episode (valid only where _hist_trunc) — flushed windows point
+        # their next_obs here so the learner bootstraps at train time.
+        self._hist_trunc = np.zeros((H, N), bool)
+        self._hist_trunc_obs = np.zeros((H, N, *obs_shape), np.uint8)
         self._rows = 0          # valid rows in history (grows to H, then stays)
         self._step_count = 0    # total fleet steps
         self.params = None
@@ -160,7 +180,8 @@ class ActorFleet:
         self.params = jax.device_put(params)
         return True
 
-    def _roll_in(self, obs, action, reward, discount, qmax, qtaken):
+    def _roll_in(self, obs, action, reward, discount, qmax, qtaken,
+                 trunc=None, final_obs=None):
         """Write one fleet step at the rotating cursor slot s % H."""
         slot = self._step_count % self._H
         self._hist_obs[slot] = obs
@@ -169,6 +190,12 @@ class ActorFleet:
         self._hist_discount[slot] = discount
         self._hist_qmax[slot] = qmax
         self._hist_qtaken[slot] = qtaken
+        if trunc is None:
+            self._hist_trunc[slot] = False
+        else:
+            self._hist_trunc[slot] = trunc
+            if trunc.any():
+                self._hist_trunc_obs[slot][trunc] = final_obs[trunc]
         self._rows = min(self._rows + 1, self._H)
 
     def _flush(self) -> Chunk:
@@ -192,6 +219,26 @@ class ActorFleet:
         next_obs = self._hist_obs[next_idx]            # [F, N, *obs]
         qtaken = self._hist_qtaken[order[:F]]
         boot_qmax = self._hist_qmax[next_idx]
+        truncs = self._hist_trunc[order[: F + n - 1]]  # [F+n-1, N]
+        if truncs.any():
+            # Truncation bootstrap (envs/core.py:24-28): a window whose
+            # FIRST done is a truncation at offset k re-targets next_obs to
+            # the episode's final observation with discount γ^(k+1); the
+            # n-step return is already correct (cumulative discount zeroes
+            # contributions past the boundary).  Priorities use Q(S_{t+k})
+            # — the last Q computed before the final obs — as the bootstrap
+            # proxy (the final obs never went through the policy net); the
+            # learner restamps with the exact value on first replay.
+            trunc_obs_seq = self._hist_trunc_obs[order[: F + n - 1]]
+            qmax_seq = self._hist_qmax[order[: F + n - 1]]
+            alive = np.ones(boot.shape, bool)          # no done before k
+            for k in range(n):
+                m = alive & truncs[k:k + F]
+                if m.any():
+                    boot[m] = self.gamma ** (k + 1)
+                    next_obs[m] = trunc_obs_seq[k:k + F][m]
+                    boot_qmax[m] = qmax_seq[k:k + F][m]
+                alive &= discounts[k:k + F] != 0.0
         # Actor priority rule: |n-step TD error| with max-Q bootstrap
         # (reference actor.py:138-142), per transition (not collapsed).
         td = returns + boot * boot_qmax - qtaken
@@ -230,29 +277,23 @@ class ActorFleet:
             vs = self.envs.step(actions)
             done = vs.terminated | vs.truncated
             discount = (self.gamma * (1.0 - done)).astype(np.float32)
-            reward = vs.reward
+            # Truncation: record the episode's final observation (vs.obs —
+            # the next policy input is vs.reset_obs, so this frame is
+            # otherwise lost).  _flush points truncated windows' next_obs at
+            # it with discount γ^(k+1), so the learner bootstraps with its
+            # LIVE target net on every replay — baking a collection-time Q
+            # into the reward would freeze a stale estimate in the buffer
+            # for the slot's whole lifetime.
             trunc = vs.truncated & ~vs.terminated
-            if trunc.any():
-                # Truncation bootstrap: the final observation never feeds the
-                # policy (next input is reset_obs), so run one extra batched
-                # forward on it and bake γ·max_a Q(S_final) into this step's
-                # reward.  Windows then stop at the boundary (discount 0)
-                # with the tail value already inside the return — the env
-                # contract's "bootstrap survives" (envs/core.py:24-28).
-                _, q_final = self._policy_step(
-                    self.params, vs.obs, self._epsilons, self._step_count
-                )
-                boot = np.asarray(q_final).max(axis=-1)
-                reward = reward + np.where(
-                    trunc, self.gamma * boot, 0.0
-                ).astype(np.float32)
             self._roll_in(
                 self._obs,
                 actions,
-                reward,
+                vs.reward,
                 discount,
                 q.max(axis=-1),
                 np.take_along_axis(q, actions[:, None], axis=-1)[:, 0],
+                trunc=trunc,
+                final_obs=vs.obs,
             )
             self._obs = vs.reset_obs
             self._step_count += 1

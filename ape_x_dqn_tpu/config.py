@@ -59,6 +59,13 @@ class ActorConfig:
     gamma: float = 0.99                   # parameters.json:14
     flush_every: int = 16                 # chunk emission period (steps)
     sync_every: int = 500                 # param poll period, parameters.json:16
+    # Actor placement: "thread" = fleets as threads in the learner process
+    # (vector/fake envs); "process" = num_workers CPU-only worker processes,
+    # params over shared memory, experience over a bounded queue
+    # (runtime/process_actors.py — the reference's mp.Process actor layout,
+    # main.py:50-54, rebuilt on the TPU transport stack).
+    mode: str = "thread"
+    num_workers: int = 2                  # worker processes (mode="process")
 
 
 @dataclasses.dataclass
@@ -82,11 +89,29 @@ class LearnerConfig:
     # each dispatch runs steps_per_call sample/train/restamp steps — the
     # throughput mode; False = host replay + per-step train (golden path).
     device_replay: bool = False
+    # Data-parallel learner over an N-device mesh (parallel/dp.py): batches
+    # shard over the ``data`` axis, XLA inserts the gradient all-reduce
+    # over ICI, priorities gather back per shard — BASELINE.md config 4.
+    # Requires the host-replay path (device_replay=False) and
+    # replay_sample_size % data_parallel == 0.
+    data_parallel: int = 1
     steps_per_call: int = 128             # K steps fused per dispatch
     # HBM-traffic knobs ("bfloat16" | None): reduced-precision RMSProp
     # second moment and target net — see make_optimizer / init_train_state.
     second_moment_dtype: Optional[str] = None
     target_dtype: Optional[str] = None
+    # Store network params in bfloat16 with a float32 master copy inside the
+    # optimizer state (train_step.with_float32_master) — halves the param
+    # HBM read on every forward/backward.  Updates accumulate in float32, so
+    # learning quality matches float32 params (chain-MDP test covers it).
+    param_dtype: Optional[str] = None
+    # Fused-mode sampling cadence: True samples all K batches of a dispatch
+    # in ONE batched inverse-CDF call from call-entry priorities and
+    # restamps once after the scan (device_replay_sample_many) — drops
+    # ~95 µs/step of fixed op overhead at B=32 for up to K steps of
+    # priority staleness, the same order the async Ape-X loop already
+    # tolerates.  False is strict sequential PER (the test oracle).
+    sample_ahead: bool = False
 
 
 @dataclasses.dataclass
@@ -114,6 +139,11 @@ class ApexConfig:
             (0.0 < a.gamma <= 1.0, "actor.gamma must be in (0, 1]"),
             (a.flush_every >= 1, "actor.flush_every must be >= 1"),
             (a.sync_every >= 1, "actor.sync_every must be >= 1"),
+            (a.mode in ("thread", "process"),
+             f"unknown actor.mode: {a.mode}"),
+            (a.num_workers >= 1, "actor.num_workers must be >= 1"),
+            (a.mode != "process" or a.num_actors >= a.num_workers,
+             "actor.num_actors must be >= actor.num_workers in process mode"),
             (l.publish_every >= 1, "learner.publish_every must be >= 1"),
             (l.replay_sample_size >= 1, "learner.replay_sample_size must be >= 1"),
             (l.q_target_sync_freq >= 1, "learner.q_target_sync_freq must be >= 1"),
@@ -130,10 +160,21 @@ class ApexConfig:
              f"unknown optimizer kind: {l.optimizer}"),
             (l.loss in ("huber", "squared"), f"unknown loss kind: {l.loss}"),
             (l.steps_per_call >= 1, "learner.steps_per_call must be >= 1"),
+            (l.data_parallel >= 1, "learner.data_parallel must be >= 1"),
+            (l.data_parallel == 1 or not l.device_replay,
+             "learner.data_parallel > 1 requires device_replay=False "
+             "(the mesh learner runs the host-replay path)"),
+            (l.replay_sample_size % l.data_parallel == 0,
+             "learner.replay_sample_size must be divisible by data_parallel"),
+            (not l.sample_ahead or l.device_replay,
+             "learner.sample_ahead=True requires device_replay=True "
+             "(it configures the fused HBM-replay scan)"),
             (l.second_moment_dtype in (None, "bfloat16", "float32"),
              f"unknown second_moment_dtype: {l.second_moment_dtype}"),
             (l.target_dtype in (None, "bfloat16", "float32"),
              f"unknown target_dtype: {l.target_dtype}"),
+            (l.param_dtype in (None, "bfloat16", "float32"),
+             f"unknown param_dtype: {l.param_dtype}"),
             (not (l.second_moment_dtype is not None and l.optimizer == "adam"),
              "second_moment_dtype is only supported for rmsprop"),
         ]
@@ -190,7 +231,7 @@ def from_reference_json(data: dict) -> ApexConfig:
 # else "none" falls through to the typed coercion and raises clearly.
 _OPTIONAL_FIELDS = {
     "state_shape", "action_dim", "max_grad_norm",
-    "second_moment_dtype", "target_dtype",
+    "second_moment_dtype", "target_dtype", "param_dtype",
 }
 
 

@@ -141,23 +141,65 @@ class AsyncPipeline:
         self.cfg = self.comps.cfg
         self.logger = logger or MetricLogger()
         self.log_every = log_every
-        self.store = ParamStore(self.comps.state.params)
         self.stop_event = threading.Event()
         self._fps = RateCounter()
         self._steps_rate = RateCounter()
         self._prefetch_depth = prefetch_depth
         self.fused = None
+        self.mesh = None
         sink = None
         if self.cfg.learner.device_replay:
             self.fused = self.comps.make_fused_learner()
+            if self.comps.restored_path is not None:
+                # Second half of resume: the train state was restored in
+                # build_components; the HBM ring reloads here, after the
+                # fused learner exists (VERDICT r2 item 6 — a learner
+                # restart must not lose the buffer).
+                from ape_x_dqn_tpu.utils.checkpoint import load_replay_snapshot
+
+                load_replay_snapshot(self.comps.restored_path, self.fused)
             sink = self.fused.add_chunk
             self.train_step = None
+        elif self.cfg.learner.data_parallel > 1:
+            # Mesh data-parallel learner (BASELINE.md config 4): the same
+            # loop below, with the step jitted over the mesh, infeed batches
+            # sharded in _place, and the replicated params published as-is.
+            self.train_step, sharded_state, self.mesh = (
+                self.comps.make_sharded_train_step()
+            )
+            self.comps.state = sharded_state
         else:
             self.train_step = self.comps.make_train_step()
-        self.worker = _ActorWorker(
-            self.comps, self.store, self.stop_event, self.logger, self._fps,
-            max_restarts=max_actor_restarts, sink=sink,
-        )
+        if self.cfg.actor.mode == "process":
+            # Actors in CPU-only worker processes: params travel as
+            # serialized snapshots through shared memory, experience through
+            # a bounded queue (runtime/process_actors.py — the reference's
+            # N-process actor layout, main.py:50-54).
+            from ape_x_dqn_tpu.runtime.process_actors import (
+                ProcessActorPool,
+                ProcessActorWorker,
+            )
+
+            pool = ProcessActorPool(
+                self.cfg, num_workers=self.cfg.actor.num_workers
+            )
+            self.store = pool.store
+            self.store.publish(self.comps.state.params)
+            self.worker = ProcessActorWorker(
+                pool,
+                sink if sink is not None else (
+                    lambda prio, trans: self.comps.replay.add(prio, trans)
+                ),
+                logger=self.logger,
+                fps=self._fps,
+                stop_event=self.stop_event,
+            )
+        else:
+            self.store = ParamStore(self.comps.state.params)
+            self.worker = _ActorWorker(
+                self.comps, self.store, self.stop_event, self.logger,
+                self._fps, max_restarts=max_actor_restarts, sink=sink,
+            )
         self._learner_step = self.comps.learner_step
         self._sample = (
             None if self.fused is not None
@@ -212,6 +254,7 @@ class AsyncPipeline:
                 depth=self._prefetch_depth,
             ) as queue:
                 pending = None  # (indices, device priorities) of previous step
+                metrics = None
                 state = self.comps.state
                 while self._learner_step < target and not self.stop_event.is_set():
                     host_indices, batch = queue.get()
@@ -239,7 +282,10 @@ class AsyncPipeline:
                     ):
                         from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
 
-                        save_checkpoint(cfg.learner.checkpoint_dir, state)
+                        save_checkpoint(
+                            cfg.learner.checkpoint_dir, state,
+                            replay=self.comps.replay,
+                        )
                     if self._learner_step % self.log_every == 0:
                         self._emit(metrics)
                 if pending is not None:
@@ -251,7 +297,9 @@ class AsyncPipeline:
             self.worker.join()
         if self.worker.error is not None:
             raise RuntimeError("actor worker died") from self.worker.error
-        return self._emit(final=True)
+        # Final emit carries the last step's metrics (one host sync) so the
+        # returned record always has learner/loss — callers assert on it.
+        return self._emit(metrics, final=True)
 
     def _run_fused(self, target: int, warmup_timeout: float) -> dict:
         """Device-replay mode: ingest staged actor chunks, then fused
@@ -300,7 +348,9 @@ class AsyncPipeline:
                 if next_ckpt is not None and self._learner_step >= next_ckpt:
                     from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
 
-                    save_checkpoint(cfg.learner.checkpoint_dir, fused.state)
+                    save_checkpoint(
+                        cfg.learner.checkpoint_dir, fused.state, replay=fused,
+                    )
                     next_ckpt += cfg.learner.checkpoint_every
                 if self._learner_step >= next_log:
                     self._emit_fused(last_metrics)
@@ -342,11 +392,17 @@ class AsyncPipeline:
         )
 
     def _place(self, host_batch):
-        """Stage a host batch on device, keeping host indices for the
-        deferred priority write-back."""
+        """Stage a host batch on device — sharded over the mesh's data axis
+        in data-parallel mode — keeping host indices for the deferred
+        priority write-back."""
         import jax
 
-        return np.asarray(host_batch.indices), jax.device_put(host_batch)
+        indices = np.asarray(host_batch.indices)
+        if self.mesh is not None:
+            from ape_x_dqn_tpu.parallel import place_batch
+
+            return indices, place_batch(host_batch, self.mesh)
+        return indices, jax.device_put(host_batch)
 
     def _emit(self, metrics=None, final: bool = False) -> dict:
         eps = self.worker.drain_episodes()

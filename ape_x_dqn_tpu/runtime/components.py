@@ -34,6 +34,10 @@ class Components:
     learner_step: int          # host-side mirror (== restored step or 0)
     replay: Optional[PrioritizedReplay]   # None in device-replay mode
     env_fns: List[Callable]
+    # Checkpoint dir/path a restore actually came from (None = scratch) —
+    # device-replay runtimes load their HBM replay snapshot from it after
+    # constructing the fused learner.
+    restored_path: Optional[str] = None
 
     def make_train_step(self):
         """The fused learner step with this config's loss/target-sync knobs —
@@ -46,6 +50,44 @@ class Components:
             loss_kind=self.cfg.learner.loss,
             target_sync_freq=self.cfg.learner.q_target_sync_freq,
         )
+
+    def make_sharded_train_step(self):
+        """The fused step jitted over a ``data_parallel``-device mesh
+        (parallel/dp.py): params replicated, batches sharded over ``data``,
+        gradient all-reduce inserted by XLA over ICI.  Returns
+        ``(step_fn, sharded_state, mesh)``; the caller adopts the sharded
+        state and places batches with ``parallel.place_batch`` —
+        BASELINE.md config 4 as a runtime mode (``learner.data_parallel``).
+        """
+        import numpy as np
+
+        from ape_x_dqn_tpu.parallel import build_sharded_train_step, make_mesh
+        from ape_x_dqn_tpu.types import NStepTransition, PrioritizedBatch
+
+        cfg = self.cfg
+        mesh = make_mesh(num_devices=cfg.learner.data_parallel)
+        B = cfg.learner.replay_sample_size
+        example = PrioritizedBatch(
+            transition=NStepTransition(
+                obs=np.zeros((B, *self.obs_shape), np.uint8),
+                action=np.zeros((B,), np.int32),
+                reward=np.zeros((B,), np.float32),
+                discount=np.zeros((B,), np.float32),
+                next_obs=np.zeros((B, *self.obs_shape), np.uint8),
+            ),
+            indices=np.zeros((B,), np.int32),
+            is_weights=np.ones((B,), np.float32),
+        )
+        step_fn, sharded_state = build_sharded_train_step(
+            self.network,
+            self.optimizer,
+            mesh,
+            self.state,
+            example,
+            loss_kind=cfg.learner.loss,
+            target_sync_freq=cfg.learner.q_target_sync_freq,
+        )
+        return step_fn, sharded_state, mesh
 
     def make_sampler(self, learner_step_fn: Callable[[], int]):
         """Replay sampler with the β-annealed IS schedule; ``learner_step_fn``
@@ -91,6 +133,7 @@ class Components:
             priority_exponent=cfg.replay.priority_exponent,
             target_sync_freq=freq,
             loss_kind=cfg.learner.loss,
+            sample_ahead=cfg.learner.sample_ahead,
         )
 
     def make_fleet(self, seed_offset: int = 0) -> ActorFleet:
@@ -134,39 +177,27 @@ def build_components(cfg: ApexConfig) -> Components:
             f"config env.action_dim {cfg.env.action_dim} != actual {num_actions}"
         )
 
-    network = build_network(cfg.network, num_actions)
     _dtypes = {"bfloat16": jnp.bfloat16, "float32": jnp.float32, None: None}
+    net_kwargs = {}
+    if _dtypes[cfg.learner.param_dtype] is not None:
+        net_kwargs["param_dtype"] = _dtypes[cfg.learner.param_dtype]
+    network = build_network(cfg.network, num_actions, **net_kwargs)
     optimizer = make_optimizer(
         cfg.learner.optimizer,
         learning_rate=cfg.learner.learning_rate,
         max_grad_norm=cfg.learner.max_grad_norm,
         second_moment_dtype=_dtypes[cfg.learner.second_moment_dtype],
     )
+    if cfg.learner.param_dtype == "bfloat16":
+        # bf16 params need f32 update accumulation (see with_float32_master).
+        from ape_x_dqn_tpu.learner.train_step import with_float32_master
+
+        optimizer = with_float32_master(optimizer)
     state = init_train_state(
         network, optimizer, jax.random.PRNGKey(cfg.seed),
         jnp.zeros((1, *obs_shape), jnp.uint8),
         target_dtype=_dtypes[cfg.learner.target_dtype],
     )
-    learner_step = 0
-    if cfg.learner.restore_from:
-        # Resume gate mirroring the reference's load_saved_state
-        # (learner.py:18-23) — restoring the FULL train state, with the same
-        # missing-file fallback to scratch.  True means "my checkpoint_dir".
-        from ape_x_dqn_tpu.utils.checkpoint import restore_checkpoint
-
-        restore_path = (
-            cfg.learner.checkpoint_dir
-            if cfg.learner.restore_from is True
-            else str(cfg.learner.restore_from)
-        )
-        try:
-            state, learner_step = restore_checkpoint(restore_path, state)
-            print(f"restored checkpoint at step {learner_step}")
-        except FileNotFoundError:
-            print(
-                f"WARNING: no checkpoint at {restore_path}; starting from scratch"
-            )
-
     if cfg.learner.device_replay:
         # Throughput mode keeps the ring in HBM (make_fused_learner); the
         # host replay would be ~capacity × 2 frames of dead host RAM.
@@ -176,6 +207,30 @@ def build_components(cfg: ApexConfig) -> Components:
             cfg.replay.capacity, obs_shape,
             priority_exponent=cfg.replay.priority_exponent,
         )
+    learner_step = 0
+    restored_path = None
+    if cfg.learner.restore_from:
+        # Resume gate mirroring the reference's load_saved_state
+        # (learner.py:18-23) — restoring the FULL train state (and the host
+        # replay snapshot, when one was saved), with the same missing-file
+        # fallback to scratch.  True means "my checkpoint_dir".
+        from ape_x_dqn_tpu.utils.checkpoint import restore_checkpoint
+
+        restore_path = (
+            cfg.learner.checkpoint_dir
+            if cfg.learner.restore_from is True
+            else str(cfg.learner.restore_from)
+        )
+        try:
+            state, learner_step = restore_checkpoint(
+                restore_path, state, replay=replay
+            )
+            restored_path = restore_path
+            print(f"restored checkpoint at step {learner_step}")
+        except FileNotFoundError:
+            print(
+                f"WARNING: no checkpoint at {restore_path}; starting from scratch"
+            )
     env_fns = [
         (lambda i=i: make_env(cfg.env.name, seed=cfg.seed + 1000 + i, **env_kwargs))
         for i in range(cfg.actor.num_actors)
@@ -190,4 +245,5 @@ def build_components(cfg: ApexConfig) -> Components:
         learner_step=learner_step,
         replay=replay,
         env_fns=env_fns,
+        restored_path=restored_path,
     )

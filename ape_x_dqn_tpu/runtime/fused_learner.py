@@ -54,6 +54,7 @@ class FusedDeviceLearner:
         priority_exponent: float = 0.6,
         target_sync_freq: int = 2500,
         loss_kind: str = "huber",
+        sample_ahead: bool = False,
     ):
         self._state = state
         self._replay = init_device_replay(capacity, obs_shape)
@@ -75,6 +76,7 @@ class FusedDeviceLearner:
             priority_exponent=priority_exponent,
             target_sync_freq=target_sync_freq,
             include_ingest=False,
+            sample_ahead=sample_ahead,
         )
         self._add = jax.jit(
             lambda r, t, p: device_replay_add(r, t, p, priority_exponent),
@@ -187,6 +189,45 @@ class FusedDeviceLearner:
         self._size += ingested
         self._ingested_blocks += n_full
         return ingested
+
+    # -- snapshot (checkpointing) ----------------------------------------
+
+    def state_dict(self) -> dict:
+        """Snapshot the HBM replay ring to host numpy (the replay leg of
+        checkpoint/resume — utils/checkpoint.save_checkpoint(replay=self)).
+        Staged-but-uningested host rows are NOT included; runtimes ingest
+        with drain before checkpointing at shutdown."""
+        r = jax.device_get(self._replay)
+        return {
+            "obs": r.obs, "next_obs": r.next_obs, "action": r.action,
+            "reward": r.reward, "discount": r.discount, "mass": r.mass,
+            "cursor": np.asarray(r.cursor), "count": np.asarray(r.count),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore the ring from a snapshot (same capacity/obs shape —
+        static HBM shapes make a resize a config error, not a migration)."""
+        import jax.numpy as jnp
+
+        from ape_x_dqn_tpu.replay.device import DeviceReplayState
+
+        want = tuple(self._replay.obs.shape)
+        got = tuple(state["obs"].shape)
+        if want != got:
+            raise ValueError(
+                f"replay snapshot shape {got} != configured ring {want}"
+            )
+        self._replay = DeviceReplayState(
+            obs=jnp.asarray(state["obs"]),
+            next_obs=jnp.asarray(state["next_obs"]),
+            action=jnp.asarray(state["action"]),
+            reward=jnp.asarray(state["reward"]),
+            discount=jnp.asarray(state["discount"]),
+            mass=jnp.asarray(state["mass"]),
+            cursor=jnp.asarray(state["cursor"]),
+            count=jnp.asarray(state["count"]),
+        )
+        self._size = int(state["count"])
 
     def train(self, beta: float):
         """One fused call: K steps of sample/train/restamp.  Returns the

@@ -102,7 +102,10 @@ class SingleProcessDriver:
                 ):
                     from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
 
-                    save_checkpoint(cfg.learner.checkpoint_dir, self.state)
+                    save_checkpoint(
+                        cfg.learner.checkpoint_dir, self.state,
+                        replay=self.replay,
+                    )
                 loss = float(metrics.loss)
                 mean_q = float(metrics.mean_q)
         return IterationResult(

@@ -73,6 +73,18 @@ def save_checkpoint(
     return path
 
 
+def _resolve_step_path(root_or_path: str) -> str:
+    """An explicit ``step_N`` dir passes through; a root resolves to its
+    newest committed checkpoint (FileNotFoundError when empty)."""
+    root_or_path = os.path.abspath(root_or_path)
+    if _STEP_RE.match(os.path.basename(root_or_path)):
+        return root_or_path
+    step = latest_step(root_or_path)
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint under {root_or_path}")
+    return _step_dir(root_or_path, step)
+
+
 def restore_checkpoint(
     root_or_path: str,
     state_template: TrainState,
@@ -88,14 +100,7 @@ def restore_checkpoint(
     that means "start from scratch" (the reference's fallback,
     learner.py:22-23) or a hard error.
     """
-    root_or_path = os.path.abspath(root_or_path)
-    if _STEP_RE.match(os.path.basename(root_or_path)):
-        path = root_or_path
-    else:
-        step = latest_step(root_or_path)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {root_or_path}")
-        path = _step_dir(root_or_path, step)
+    path = _resolve_step_path(root_or_path)
     with ocp.StandardCheckpointer() as ckptr:
         state = ckptr.restore(
             os.path.join(path, "state"), jax.device_get(state_template)
@@ -109,11 +114,23 @@ def restore_checkpoint(
         state_template,
         state,
     )
-    replay_file = os.path.join(path, "replay.npz")
-    if replay is not None and os.path.exists(replay_file):
-        with np.load(replay_file) as z:
-            replay.load_state_dict({k: z[k] for k in z.files})
+    if replay is not None:
+        load_replay_snapshot(path, replay)
     return state, int(jax.device_get(state.step))
+
+
+def load_replay_snapshot(root_or_path: str, replay) -> bool:
+    """Load the newest checkpoint's replay snapshot into ``replay`` (any
+    object with ``load_state_dict``).  Returns False when the checkpoint has
+    no replay leg — runtimes that construct their replay after the train
+    state was restored (the fused device learner) use this for the second
+    half of resume."""
+    replay_file = os.path.join(_resolve_step_path(root_or_path), "replay.npz")
+    if not os.path.exists(replay_file):
+        return False
+    with np.load(replay_file) as z:
+        replay.load_state_dict({k: z[k] for k in z.files})
+    return True
 
 
 def _prune(root: str, keep: int) -> None:

@@ -24,6 +24,7 @@ learner.py:3 solely for ``sleep`` — reference SURVEY §5).
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 from typing import Callable, Dict, Iterator, Optional
@@ -32,14 +33,16 @@ from typing import Callable, Dict, Iterator, Optional
 class StageTimer:
     """Named wall-clock accumulators: ``with timer.stage("sample"): ...``.
 
-    Cheap enough for hot loops (one ``perf_counter`` pair per section) and
-    thread-compatible by virtue of only using per-call locals plus atomic
-    dict updates under CPython.
+    Cheap enough for hot loops (one ``perf_counter`` pair per section plus
+    one uncontended lock acquire — the ``+=`` on a dict item is a
+    read-modify-write, NOT atomic under CPython, so cross-thread updates
+    need the lock to not lose counts).
     """
 
     def __init__(self):
         self._total_s: Dict[str, float] = defaultdict(float)
         self._count: Dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
@@ -48,34 +51,42 @@ class StageTimer:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self._total_s[name] += dt
-            self._count[name] += 1
+            with self._lock:
+                self._total_s[name] += dt
+                self._count[name] += 1
 
     def add(self, name: str, seconds: float) -> None:
-        self._total_s[name] += seconds
-        self._count[name] += 1
+        with self._lock:
+            self._total_s[name] += seconds
+            self._count[name] += 1
 
     def us_per_call(self) -> Dict[str, float]:
+        with self._lock:  # readers too: a concurrent first-use of a stage
+            # name inserts into the defaultdict mid-iteration otherwise
+            totals, counts = dict(self._total_s), dict(self._count)
         return {
-            name: round(self._total_s[name] / max(1, self._count[name]) * 1e6, 1)
-            for name in self._total_s
+            name: round(totals[name] / max(1, counts[name]) * 1e6, 1)
+            for name in totals
         }
 
     def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            totals, counts = dict(self._total_s), dict(self._count)
         return {
             name: {
-                "total_s": round(self._total_s[name], 4),
-                "calls": self._count[name],
+                "total_s": round(totals[name], 4),
+                "calls": counts[name],
                 "us_per_call": round(
-                    self._total_s[name] / max(1, self._count[name]) * 1e6, 1
+                    totals[name] / max(1, counts[name]) * 1e6, 1
                 ),
             }
-            for name in self._total_s
+            for name in totals
         }
 
     def reset(self) -> None:
-        self._total_s.clear()
-        self._count.clear()
+        with self._lock:
+            self._total_s.clear()
+            self._count.clear()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,183 @@
+"""Flat-numpy pytree ↔ bytes — the cross-process / cross-host wire format.
+
+The reference's only serialization is implicit: ``multiprocessing`` pickles
+the learner's full ``state_dict`` through the manager server on every update
+(reference learner.py:74, main.py:38).  This module is the explicit seam the
+TPU build routes instead: the learner snapshots params once per publish
+(``tree_to_bytes``), the bytes travel over whatever transport the deployment
+has (shared memory ring on one host — runtime/process_actors.py; a DCN
+fetch between hosts), and the receiver reconstructs numpy leaves without
+executing any pickled code (``tree_from_bytes`` parses a JSON manifest +
+raw buffers — nothing in the payload is executable, unlike pickle).
+
+Format (little-endian):
+
+    b"APXT" | u32 format version (=1) | u64 header_len | header JSON | buffers
+
+where the header is ``{"leaves": [{"path": [...], "dtype": str,
+"shape": [...]}, ...]}`` and each path element is one of
+``{"k": str}`` (dict key), ``{"i": int}`` (sequence index) or
+``{"a": str}`` (dataclass/attr field — restorable only via a template).
+Buffers are the leaves' C-contiguous bytes concatenated in manifest order.
+
+Two restore modes:
+  * ``tree_from_bytes(data)`` — standalone: rebuilds nested dict/list
+    structure from the paths (covers flax param dicts, the common case).
+  * ``restore_like(template, data)`` — template-shaped: unflattens into an
+    arbitrary pytree structure (TrainState, optimizer states) after
+    verifying path/dtype/shape agreement leaf by leaf.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, List
+
+import jax
+import numpy as np
+
+_MAGIC = b"APXT"
+_VERSION = 1
+_PREFIX = struct.Struct("<4sIQ")  # magic, version, header_len
+
+
+def _path_entry(key) -> dict:
+    kind = type(key).__name__
+    if kind == "DictKey":
+        return {"k": str(key.key)}
+    if kind == "SequenceKey":
+        return {"i": int(key.idx)}
+    if kind == "GetAttrKey":
+        return {"a": str(key.name)}
+    if kind == "FlattenedIndexKey":
+        return {"i": int(key.key)}
+    raise TypeError(f"unsupported pytree path element: {key!r}")
+
+
+def tree_to_bytes(tree: Any) -> bytes:
+    """Serialize a pytree of array-likes to a self-describing byte string."""
+    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(tree)
+    manifest: List[dict] = []
+    buffers: List[bytes] = []
+    for path, leaf in leaves_with_path:
+        arr = np.asarray(leaf)
+        if not arr.flags.c_contiguous:
+            # NB: unconditional ascontiguousarray would silently promote
+            # 0-d scalars (step counters) to shape (1,).
+            arr = np.ascontiguousarray(arr)
+        # bfloat16 has no numpy wire dtype — ship as uint16 raw bits.
+        dtype = str(arr.dtype)
+        if dtype == "bfloat16":
+            arr = arr.view(np.uint16)
+            dtype = "bfloat16"
+        manifest.append(
+            {
+                "path": [_path_entry(k) for k in path],
+                "dtype": dtype,
+                "shape": list(arr.shape),
+            }
+        )
+        buffers.append(arr.tobytes())
+    header = json.dumps({"leaves": manifest}).encode()
+    return b"".join(
+        [_PREFIX.pack(_MAGIC, _VERSION, len(header)), header, *buffers]
+    )
+
+
+def _parse(data) -> List[tuple]:
+    """Parse into [(path_entries, numpy array), ...] in manifest order."""
+    view = memoryview(data)
+    magic, version, header_len = _PREFIX.unpack_from(view, 0)
+    if magic != _MAGIC:
+        raise ValueError("not an APXT snapshot (bad magic)")
+    if version != _VERSION:
+        raise ValueError(f"unsupported snapshot format version {version}")
+    off = _PREFIX.size
+    header = json.loads(bytes(view[off:off + header_len]))
+    off += header_len
+    out = []
+    for entry in header["leaves"]:
+        shape = tuple(entry["shape"])
+        if entry["dtype"] == "bfloat16":
+            import jax.numpy as jnp
+
+            n = int(np.prod(shape, dtype=np.int64)) * 2
+            raw = np.frombuffer(view, np.uint16, n // 2, off).reshape(shape)
+            arr = raw.view(jnp.bfloat16)
+            off += n
+        else:
+            dt = np.dtype(entry["dtype"])
+            n = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+            arr = np.frombuffer(view, dt, n // dt.itemsize, off).reshape(shape)
+            off += n
+        out.append((entry["path"], arr.copy()))  # own the memory
+    return out
+
+
+def tree_from_bytes(data) -> Any:
+    """Standalone restore: nested dicts (``k`` keys) / lists (``i`` keys).
+
+    Payloads containing attr-path elements (``a`` — struct dataclasses)
+    need a structure template; use ``restore_like`` for those.
+    """
+    leaves = _parse(data)
+    if len(leaves) == 1 and not leaves[0][0]:
+        return leaves[0][1]
+
+    def key_of(entry):
+        if "a" in entry:
+            raise ValueError(
+                "snapshot contains attr paths (struct dataclasses); "
+                "restore with restore_like(template, data)"
+            )
+        return entry.get("k", entry.get("i"))
+
+    def child_slot(node, key, make):
+        if isinstance(node, list):
+            while len(node) <= key:
+                node.append(None)
+            if make is not None and node[key] is None:
+                node[key] = make()
+            return node[key] if make is not None else key
+        if make is not None:
+            return node.setdefault(key, make())
+        return key
+
+    root: Any = [] if "i" in leaves[0][0][0] else {}
+    for path, arr in leaves:
+        node = root
+        for i, entry in enumerate(path[:-1]):
+            nxt_is_list = "i" in path[i + 1]
+            node = child_slot(node, key_of(entry),
+                              make=(list if nxt_is_list else dict))
+        key = key_of(path[-1])
+        if isinstance(node, list):
+            while len(node) <= key:
+                node.append(None)
+        node[key] = arr
+    return root
+
+
+def restore_like(template: Any, data) -> Any:
+    """Restore into ``template``'s exact pytree structure, verifying every
+    leaf's path, dtype, and shape against the manifest."""
+    leaves = _parse(data)
+    t_paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    if len(leaves) != len(t_paths):
+        raise ValueError(
+            f"snapshot has {len(leaves)} leaves, template has {len(t_paths)}"
+        )
+    new_leaves = []
+    for (path, arr), (t_path, t_leaf) in zip(leaves, t_paths):
+        want = [_path_entry(k) for k in t_path]
+        if want != path:
+            raise ValueError(f"leaf path mismatch: snapshot {path} != template {want}")
+        t_arr = np.asarray(t_leaf)
+        if tuple(arr.shape) != tuple(t_arr.shape) or str(arr.dtype) != str(t_arr.dtype):
+            raise ValueError(
+                f"leaf {path}: snapshot {arr.dtype}{arr.shape} != "
+                f"template {t_arr.dtype}{t_arr.shape}"
+            )
+        new_leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)

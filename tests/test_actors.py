@@ -6,7 +6,7 @@ import jax
 import pytest
 
 from ape_x_dqn_tpu.actors import ActorFleet, LocalParamSource
-from ape_x_dqn_tpu.envs import ChainMDP, LoopEnv, RandomFrameEnv
+from ape_x_dqn_tpu.envs import ChainMDP, RandomFrameEnv
 from ape_x_dqn_tpu.models.dueling import DuelingMLP
 from ape_x_dqn_tpu.ops.nstep import nstep_returns_np, nstep_returns_reference
 
@@ -60,10 +60,18 @@ def test_chunk_shapes_and_dtypes():
 
 
 def test_discount_zero_at_terminals():
-    # ChainMDP(6, time_limit=20) ends episodes every <=20 steps, so over 128
-    # steps many emitted windows contain an episode boundary; their bootstrap
-    # discounts must be exactly 0, and none may exceed gamma^n.
-    fleet, _ = make_fleet(num_actors=1, n_step=2, flush_every=8, gamma=0.9)
+    # ChainMDP(2) TERMINATES (not truncates) whenever action 1 is taken from
+    # the start state, so over 128 steps many emitted windows contain a true
+    # MDP terminal; their bootstrap discounts must be exactly 0 (truncation
+    # windows instead keep γ^(k+1) — covered by the truncation tests), and
+    # none may exceed gamma^n.
+    net = DuelingMLP(num_actions=2, hidden_sizes=(16,))
+    fleet = ActorFleet(
+        [lambda: ChainMDP(2, time_limit=20)],
+        net, n_step=2, flush_every=8, gamma=0.9,
+    )
+    params = net.init(jax.random.PRNGKey(0), np.zeros((1, 2), np.uint8))
+    fleet.sync_params(LocalParamSource(params))
     chunks, stats = fleet.collect(128)
     disc = np.concatenate([c.transitions.discount for c in chunks])
     assert np.all(disc <= 0.9**2 + 1e-6)
@@ -72,14 +80,41 @@ def test_discount_zero_at_terminals():
     assert all(1 <= s.episode_length <= 20 for s in stats)
 
 
-def test_truncation_bootstrap_folds_q_into_reward():
-    """Truncated steps keep their bootstrap (envs/core.py contract): the
-    emitted reward at a truncation step must be r + γ·max_a Q(S_final) and
-    its discount 0, while ordinary steps carry the raw reward and γ."""
+class _CountEnv:
+    """Truncation probe with DISTINGUISHABLE observations: obs = [t]*4, so
+    the episode's final observation (t == time_limit) differs from both the
+    reset obs (t == 0) and every interior one — the test can see exactly
+    which frame a truncated window bootstraps from."""
+
+    def __init__(self, time_limit=5):
+        self.time_limit = int(time_limit)
+        self.observation_shape = (4,)
+        self.num_actions = 2
+        self._t = 0
+
+    def _obs(self):
+        return np.full(4, self._t, np.uint8)
+
+    def reset(self, seed=None):
+        self._t = 0
+        return self._obs()
+
+    def step(self, action):
+        from ape_x_dqn_tpu.envs.core import StepResult
+
+        self._t += 1
+        return StepResult(self._obs(), 1.0, False, self._t >= self.time_limit)
+
+
+def test_truncation_stores_final_obs_for_learner_bootstrap():
+    """Truncated windows keep their bootstrap (envs/core.py contract), and
+    it is the LEARNER's: the emitted transition carries the raw reward,
+    next_obs = S_final and discount γ^(k+1), so the target net — not a
+    frozen collection-time Q — values the tail on every replay."""
     gamma = 0.9
     net = DuelingMLP(num_actions=2, hidden_sizes=(16,))
     fleet = ActorFleet(
-        [lambda: LoopEnv(time_limit=5)] * 2,
+        [lambda: _CountEnv(time_limit=5)] * 2,
         net,
         n_step=1,
         flush_every=5,
@@ -90,36 +125,61 @@ def test_truncation_bootstrap_folds_q_into_reward():
     chunks, stats = fleet.collect(31)
     rewards = np.concatenate([c.transitions.reward for c in chunks])
     discounts = np.concatenate([c.transitions.discount for c in chunks])
-    qmax = float(
-        np.asarray(net.apply(params, np.full((1, 4), 255, np.uint8))[2]).max()
+    obs = np.concatenate([c.transitions.obs for c in chunks])
+    next_obs = np.concatenate([c.transitions.next_obs for c in chunks])
+    # Rewards are raw — never inflated by a baked-in Q bootstrap.
+    np.testing.assert_allclose(rewards, 1.0, rtol=1e-6)
+    # _CountEnv never terminates: every window bootstraps, discount == γ.
+    np.testing.assert_allclose(discounts, gamma, rtol=1e-6)
+    # Windows starting at t=4 truncate: their next_obs is the FINAL obs
+    # (t=5), not the next episode's reset/first frames (t∈{0,1}).
+    at_trunc = obs[:, 0] == 4
+    assert at_trunc.any()
+    np.testing.assert_array_equal(next_obs[at_trunc][:, 0], 5)
+    # Ordinary windows chain to the in-episode successor.
+    interior = obs[:, 0] < 4
+    np.testing.assert_array_equal(
+        next_obs[interior][:, 0], obs[interior][:, 0] + 1
     )
-    trunc = discounts == 0.0
-    assert trunc.any() and (~trunc).any()
-    np.testing.assert_allclose(rewards[~trunc], 1.0, rtol=1e-6)
-    np.testing.assert_allclose(rewards[trunc], 1.0 + gamma * qmax, rtol=1e-5)
     # Truncated episodes still close out stats.
     assert stats and all(s.episode_length == 5 for s in stats)
 
 
 def test_truncation_window_never_crosses_episodes():
-    """n-step windows that span a truncation must cut there (discount 0) —
-    the bootstrap is inside the reward, never from next-episode states."""
+    """n-step windows that span a truncation cut there: discount γ^(k+1)
+    (k = offset of the boundary), return contributions past it zeroed, and
+    next_obs re-targeted to the final obs — never next-episode states."""
+    gamma = 0.9
     fleet = ActorFleet(
-        [lambda: LoopEnv(time_limit=5)],
+        [lambda: _CountEnv(time_limit=5)],
         DuelingMLP(num_actions=2, hidden_sizes=(8,)),
         n_step=3,
         flush_every=5,
-        gamma=0.9,
+        gamma=gamma,
     )
     net = fleet.network
     params = net.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.uint8))
     fleet.sync_params(LocalParamSource(params))
     chunks, _ = fleet.collect(40)
     disc = np.concatenate([c.transitions.discount for c in chunks])
-    # Every window either runs n clean steps (γ^n) or hits the boundary (0).
-    uniq = np.unique(disc)
-    assert np.isclose(uniq[:, None], [0.0, 0.9**3], atol=1e-6).any(axis=1).all(), uniq
-    assert (disc == 0.0).any() and (disc > 0).any()
+    obs = np.concatenate([c.transitions.obs for c in chunks])
+    next_obs = np.concatenate([c.transitions.next_obs for c in chunks])
+    rets = np.concatenate([c.transitions.reward for c in chunks])
+    # Window from t covers offsets until the boundary at t=4 (k = 4 - t for
+    # t >= 2): discount γ^(k+1), next_obs = final obs (t=5), return = sum of
+    # discounted +1 rewards up to the boundary.
+    t0 = obs[:, 0]
+    for t, k in ((2, 2), (3, 1), (4, 0)):
+        m = t0 == t
+        assert m.any()
+        np.testing.assert_allclose(disc[m], gamma ** (k + 1), rtol=1e-6)
+        np.testing.assert_array_equal(next_obs[m][:, 0], 5)
+        want_ret = sum(gamma ** j for j in range(k + 1))
+        np.testing.assert_allclose(rets[m], want_ret, rtol=1e-6)
+    # Clean windows (start t<2) run the full horizon inside the episode.
+    m = t0 < 2
+    np.testing.assert_allclose(disc[m], gamma ** 3, rtol=1e-6)
+    np.testing.assert_array_equal(next_obs[m][:, 0], t0[m] + 3)
 
 
 def test_episode_stats_accumulate_reward():

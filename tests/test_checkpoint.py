@@ -147,3 +147,106 @@ def test_driver_restore_gate(tmp_path):
     c3.learner.restore_from = str(tmp_path / "missing")
     d3 = SingleProcessDriver(c3)
     assert d3.learner_step == 0
+
+
+def test_async_pipeline_kill_and_resume(tmp_path):
+    """VERDICT r2 item 6: train, checkpoint, then a NEW pipeline resumes —
+    learner step AND replay contents both survive the restart."""
+    from ape_x_dqn_tpu.config import ApexConfig
+    from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
+
+    def make_cfg():
+        cfg = ApexConfig()
+        cfg.network = "mlp"
+        cfg.env.name = "chain:6"
+        cfg.actor.num_actors = 2
+        cfg.actor.T = 100_000
+        cfg.actor.flush_every = 8
+        cfg.actor.sync_every = 16
+        cfg.learner.min_replay_mem_size = 128
+        cfg.learner.optimizer = "adam"
+        cfg.learner.checkpoint_every = 50
+        cfg.learner.checkpoint_dir = str(tmp_path / "ckpt")
+        cfg.replay.capacity = 4096
+        return cfg
+
+    pipe1 = AsyncPipeline(make_cfg(), log_every=100)
+    pipe1.run(learner_steps=100, warmup_timeout=120.0)
+    saved_size = pipe1.comps.replay.size()
+    assert saved_size > 0
+
+    cfg2 = make_cfg()
+    cfg2.learner.restore_from = True  # "my checkpoint_dir"
+    pipe2 = AsyncPipeline(cfg2, log_every=100)
+    # Both the step counter and the buffer crossed the process boundary.
+    assert pipe2.comps.learner_step == 100
+    assert pipe2.learner_step == 100
+    restored_size = pipe2.comps.replay.size()
+    assert 0 < restored_size <= saved_size  # saved at the step-100 checkpoint
+    # And training continues from there rather than restarting.
+    result = pipe2.run(learner_steps=150, warmup_timeout=120.0)
+    assert result["step"] >= 150
+
+
+def test_fused_learner_replay_snapshot_roundtrip(tmp_path):
+    """Device-replay (HBM ring) checkpoint leg: save via save_checkpoint
+    (replay=fused learner), restore via load_replay_snapshot."""
+    from ape_x_dqn_tpu.runtime.fused_learner import FusedDeviceLearner
+    from ape_x_dqn_tpu.utils.checkpoint import load_replay_snapshot
+
+    net = DuelingMLP(num_actions=3, hidden_sizes=(16,))
+    opt = make_optimizer("adam", learning_rate=1e-3)
+
+    def make_fused():
+        state = init_train_state(net, opt, jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.uint8))
+        return FusedDeviceLearner(
+            net, opt, state, (8,), capacity=128, batch_size=16,
+            steps_per_call=4, ingest_block=32, target_sync_freq=8,
+        )
+
+    fused = make_fused()
+    r = np.random.default_rng(0)
+    M = 64
+    fused.add_chunk(
+        np.abs(r.normal(size=M)).astype(np.float32) + 0.1,
+        NStepTransition(
+            obs=r.integers(0, 255, (M, 8), dtype=np.uint8),
+            action=r.integers(0, 3, (M,), dtype=np.int32),
+            reward=r.normal(size=(M,)).astype(np.float32),
+            discount=np.full((M,), 0.9, np.float32),
+            next_obs=r.integers(0, 255, (M, 8), dtype=np.uint8),
+        ),
+    )
+    fused.ingest_staged()
+    fused.train(beta=0.4)
+    path = save_checkpoint(str(tmp_path), fused.state, replay=fused)
+    assert "replay.npz" in str(list(__import__("os").listdir(path)))
+
+    fused2 = make_fused()
+    assert load_replay_snapshot(str(tmp_path), fused2)
+    assert fused2.size == fused.size
+    np.testing.assert_array_equal(
+        np.asarray(fused2._replay.mass), np.asarray(fused._replay.mass)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fused2._replay.obs), np.asarray(fused._replay.obs)
+    )
+    # Restored ring trains immediately.
+    metrics = fused2.train(beta=0.4)
+    assert np.isfinite(np.asarray(metrics.loss)).all()
+
+
+def test_load_replay_snapshot_absent_returns_false(tmp_path):
+    net = DuelingMLP(num_actions=3, hidden_sizes=(16,))
+    opt = make_optimizer("adam")
+    state = init_train_state(net, opt, jax.random.PRNGKey(0),
+                             jnp.zeros((1, 8), jnp.uint8))
+    save_checkpoint(str(tmp_path), state)  # no replay leg
+    from ape_x_dqn_tpu.utils.checkpoint import load_replay_snapshot
+
+    class Sink:
+        def load_state_dict(self, d):
+            raise AssertionError("must not be called")
+
+    assert load_replay_snapshot(str(tmp_path), Sink()) is False

@@ -1,0 +1,148 @@
+"""Process-parallel actor tests (VERDICT r2 item 3): shared-memory seqlock,
+worker processes feeding a learner, param-version propagation.
+
+These run real OS processes (spawn context, CPU-only jax in workers), so
+they are the slowest tests in the suite — kept few and sharp.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.config import ApexConfig
+from ape_x_dqn_tpu.runtime.process_actors import (
+    ProcessActorPool,
+    SharedBufferParamSource,
+    SharedMemoryParamStore,
+    SharedParamBuffer,
+)
+
+
+class TestSharedParamBuffer:
+    def test_write_read_roundtrip(self):
+        buf = SharedParamBuffer(1024)
+        try:
+            assert buf.read(-1, timeout=0.05) is None  # nothing published
+            v = buf.write(b"hello")
+            assert v == 1
+            payload, version = buf.read(-1)
+            assert payload == b"hello" and version == 1
+            # Same version is filtered by have_version.
+            assert buf.read(1, timeout=0.05) is None
+            v = buf.write(b"world!")
+            payload, version = buf.read(1)
+            assert payload == b"world!" and version == 2
+        finally:
+            buf.close()
+
+    def test_capacity_guard(self):
+        buf = SharedParamBuffer(8)
+        try:
+            with pytest.raises(ValueError, match="exceeds"):
+                buf.write(b"123456789")
+        finally:
+            buf.close()
+
+    def test_torn_write_times_out_not_hangs(self):
+        """A writer that died mid-write (odd version) must not hang readers."""
+        buf = SharedParamBuffer(64)
+        try:
+            import struct
+
+            struct.Struct("<qq").pack_into(buf._shm.buf, 0, 1, 4)  # odd
+            t0 = time.monotonic()
+            assert buf.read(-1, timeout=0.1) is None
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            buf.close()
+
+    def test_concurrent_reader_never_sees_torn_payload(self):
+        buf = SharedParamBuffer(4096)
+        stop = threading.Event()
+        bad = []
+
+        def reader():
+            while not stop.is_set():
+                got = buf.read(-1, timeout=0.05)
+                if got is not None:
+                    payload, _ = got
+                    if len(set(payload)) != 1:  # must be homogeneous
+                        bad.append(payload)
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        try:
+            for i in range(200):
+                byte = bytes([i % 251])
+                buf.write(byte * 2048)
+            stop.set()
+            t.join(5.0)
+            assert not bad, f"torn payloads observed: {len(bad)}"
+        finally:
+            stop.set()
+            buf.close()
+
+
+class TestStoreAndSource:
+    def test_params_roundtrip_via_shared_memory(self):
+        from ape_x_dqn_tpu.models.dueling import DuelingMLP
+
+        net = DuelingMLP(num_actions=3, hidden_sizes=(8,))
+        params = net.init(jax.random.PRNGKey(0), np.zeros((1, 4), np.float32))
+        host = jax.device_get(params)
+        buf = SharedParamBuffer(1 << 20)
+        try:
+            store = SharedMemoryParamStore(buf)
+            v = store.publish(host)
+            assert v == 1 and store.version == 1
+            template = net.init(jax.random.PRNGKey(7), np.zeros((1, 4), np.float32))
+            source = SharedBufferParamSource(buf, jax.device_get(template))
+            restored, version = source.get(-1)
+            assert version == 1
+            for a, b in zip(jax.tree_util.tree_leaves(host),
+                            jax.tree_util.tree_leaves(restored)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            assert source.get(1) is None  # no new version
+        finally:
+            buf.close()
+
+
+class TestEndToEnd:
+    def test_two_actor_processes_feed_learner(self):
+        """VERDICT r2 'done' criterion: >=2 actor *processes* + learner
+        training the chain MDP, with param-version propagation asserted."""
+        from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
+
+        cfg = ApexConfig()
+        cfg.network = "mlp"
+        cfg.env.name = "chain:6"
+        cfg.actor.mode = "process"
+        cfg.actor.num_workers = 2
+        cfg.actor.num_actors = 4
+        cfg.actor.T = 100_000
+        cfg.actor.flush_every = 8
+        cfg.actor.sync_every = 16
+        cfg.learner.min_replay_mem_size = 256
+        cfg.learner.publish_every = 5
+        cfg.learner.total_steps = 200
+        cfg.learner.optimizer = "adam"
+        cfg.learner.learning_rate = 1e-3
+        cfg.replay.capacity = 4096
+        pipe = AsyncPipeline(cfg, log_every=100)
+        result = pipe.run(learner_steps=200, warmup_timeout=240.0)
+        pool = pipe.worker.pool
+        assert result["step"] >= 200
+        assert result["actor_steps"] > 0
+        # Both workers contributed experience.
+        assert set(pool.last_versions) == {0, 1}
+        # Param-version propagation: chunks arriving late in the run carry a
+        # version beyond the initial publish — workers really did re-pull
+        # through the shared-memory store.
+        assert pipe.store.version > 1
+        assert max(pool.last_versions.values()) > 1
+        assert not pool.worker_errors
+        # Learner actually trained on the workers' experience.
+        assert np.isfinite(result.get("learner/loss", 0.0))

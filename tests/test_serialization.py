@@ -1,0 +1,98 @@
+"""Wire-format tests: flat-numpy snapshot <-> bytes (utils/serialization)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.learner.train_step import init_train_state, make_optimizer
+from ape_x_dqn_tpu.models.dueling import DuelingMLP
+from ape_x_dqn_tpu.utils.serialization import (
+    restore_like,
+    tree_from_bytes,
+    tree_to_bytes,
+)
+
+
+def assert_trees_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert str(np.asarray(x).dtype) == str(np.asarray(y).dtype)
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestRoundTrip:
+    def test_flax_params_standalone(self):
+        net = DuelingMLP(num_actions=3, hidden_sizes=(16, 8))
+        params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 6)))
+        data = tree_to_bytes(jax.device_get(params))
+        out = tree_from_bytes(data)
+        assert_trees_equal(params, out)
+        # The restored dict is directly usable by the network.
+        q1 = net.apply(params, jnp.ones((2, 6)))[2]
+        q2 = net.apply(out, jnp.ones((2, 6)))[2]
+        np.testing.assert_allclose(np.asarray(q1), np.asarray(q2))
+
+    def test_single_array(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = tree_from_bytes(tree_to_bytes(x))
+        np.testing.assert_array_equal(out, x)
+
+    def test_nested_lists_and_dicts(self):
+        tree = {"a": [np.ones(3), {"b": np.zeros((2, 2), np.int32)}],
+                "c": np.full(1, 7, np.uint8)}
+        out = tree_from_bytes(tree_to_bytes(tree))
+        assert_trees_equal(tree, out)
+
+    def test_bfloat16_leaves(self):
+        x = {"w": jnp.asarray([1.5, -2.25, 3.0], jnp.bfloat16)}
+        out = tree_from_bytes(tree_to_bytes(jax.device_get(x)))
+        assert out["w"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(out["w"], np.float32), np.asarray(x["w"], np.float32)
+        )
+
+    def test_train_state_restore_like(self):
+        net = DuelingMLP(num_actions=3, hidden_sizes=(16,))
+        opt = make_optimizer("rmsprop", second_moment_dtype=jnp.bfloat16,
+                             max_grad_norm=None)
+        state = init_train_state(net, opt, jax.random.PRNGKey(1),
+                                 jnp.zeros((1, 6)), target_dtype=jnp.bfloat16)
+        data = tree_to_bytes(jax.device_get(state))
+        # A fresh template with different values restores to the original.
+        template = init_train_state(net, opt, jax.random.PRNGKey(2),
+                                    jnp.zeros((1, 6)), target_dtype=jnp.bfloat16)
+        out = restore_like(jax.device_get(template), data)
+        assert_trees_equal(state, out)
+
+
+class TestErrors:
+    def test_bad_magic(self):
+        with pytest.raises(ValueError, match="bad magic"):
+            tree_from_bytes(b"XXXX" + b"\0" * 32)
+
+    def test_leaf_count_mismatch(self):
+        data = tree_to_bytes({"a": np.ones(2)})
+        with pytest.raises(ValueError, match="leaves"):
+            restore_like({"a": np.ones(2), "b": np.ones(2)}, data)
+
+    def test_shape_mismatch(self):
+        data = tree_to_bytes({"a": np.ones(2)})
+        with pytest.raises(ValueError, match="template"):
+            restore_like({"a": np.ones(3)}, data)
+
+    def test_path_mismatch(self):
+        data = tree_to_bytes({"a": np.ones(2)})
+        with pytest.raises(ValueError, match="path mismatch"):
+            restore_like({"b": np.ones(2)}, data)
+
+    def test_attr_paths_need_template(self):
+        net = DuelingMLP(num_actions=3, hidden_sizes=(8,))
+        opt = make_optimizer("adam")
+        state = init_train_state(net, opt, jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 6)))
+        data = tree_to_bytes(jax.device_get(state))
+        with pytest.raises(ValueError, match="restore_like"):
+            tree_from_bytes(data)
