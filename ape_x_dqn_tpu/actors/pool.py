@@ -269,11 +269,12 @@ class ActorFleet:
         chunks: List[Chunk] = []
         stats: List[EpisodeStat] = []
         for _ in range(num_steps):
-            actions_d, q_d = self._policy_step(
+            # One transfer for both outputs: each device round trip costs
+            # fixed latency (tunneled platforms: ~100-250 ms), so the fleet
+            # batch size — not the per-actor work — sets the FPS ceiling.
+            actions, q = jax.device_get(self._policy_step(
                 self.params, self._obs, self._epsilons, self._step_count
-            )
-            actions = np.asarray(actions_d)
-            q = np.asarray(q_d)
+            ))
             vs = self.envs.step(actions)
             done = vs.terminated | vs.truncated
             discount = (self.gamma * (1.0 - done)).astype(np.float32)

@@ -136,15 +136,25 @@ class AsyncPipeline:
         log_every: int = 500,
         prefetch_depth: int = 2,
         max_actor_restarts: int = 3,
+        fused_inflight: int = 2,
     ):
         self.comps = build_components(cfg)
         self.cfg = self.comps.cfg
         self.logger = logger or MetricLogger()
         self.log_every = log_every
         self.stop_event = threading.Event()
-        self._fps = RateCounter()
-        self._steps_rate = RateCounter()
+        # 30 s windows: chunk arrivals are bursty (one flush of a 512-actor
+        # fleet is ~8k transitions), so narrow windows see 0 or 1 bursts.
+        self._fps = RateCounter(window_s=30.0)
+        self._steps_rate = RateCounter(window_s=30.0)
         self._prefetch_depth = prefetch_depth
+        # Device-queue fairness (fused mode): with no cap the learner
+        # enqueues K-step programs back-to-back and every actor policy_step
+        # waits behind the whole backlog — actors starve (measured: FPS
+        # drops ~30x).  Capping in-flight fused calls to ``fused_inflight``
+        # (forcing call i-1's metrics to host before dispatching i+1)
+        # bounds actor latency to ~one fused call.
+        self._fused_inflight = max(1, int(fused_inflight))
         self.fused = None
         self.mesh = None
         sink = None
@@ -312,6 +322,7 @@ class AsyncPipeline:
         fused = self.fused
         self.worker.start()
         last_metrics = None
+        inflight: list = []  # metrics of dispatched-but-unforced calls
         try:
             # Drain partial blocks once the actors are done — otherwise a
             # tail of < ingest_block staged rows can strand warmup below the
@@ -335,6 +346,12 @@ class AsyncPipeline:
                     cfg.replay.is_exponent,
                 )
                 last_metrics = fused.train(beta)
+                inflight.append(last_metrics)
+                if len(inflight) >= self._fused_inflight:
+                    # Force the oldest call's completion with one tiny host
+                    # read (block_until_ready is a no-op on tunneled
+                    # platforms — see bench.py methodology note).
+                    float(np.asarray(inflight.pop(0).loss[-1]))
                 self._learner_step += fused.steps_per_call
                 self._steps_rate.add(fused.steps_per_call)
                 self.comps.state = fused.state

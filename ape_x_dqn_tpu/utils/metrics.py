@@ -26,6 +26,7 @@ class RateCounter:
         self._window = window_s
         self._events: deque[tuple[float, float]] = deque()  # (time, count)
         self._total = 0.0
+        self._born = time.monotonic()
         self._lock = threading.Lock()
 
     def add(self, n: float = 1.0) -> None:
@@ -50,7 +51,11 @@ class RateCounter:
                 self._events.popleft()
             if not self._events:
                 return 0.0
-            span = max(now - self._events[0][0], 1e-9)
+            # Fixed-window denominator (clamped to the counter's age):
+            # dividing by the first-event-to-now span instead inflates the
+            # rate arbitrarily for bursty arrivals — one 8k-transition
+            # chunk landing 0.5 s ago would read as 16k/s.
+            span = max(min(self._window, now - self._born), 1e-9)
             return sum(n for _, n in self._events) / span
 
 

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -106,6 +107,102 @@ def _validate_samplers(rng) -> dict:
     return out
 
 
+def _pipeline_bench(learner_steps: int = 20_000, steps_per_call: int = 1024,
+                    publish_every: int = 4000, num_actors: int = 512) -> dict:
+    """End-to-end async pipeline on the real chip (VERDICT r2 item 2): actor
+    threads stepping RandomFrameEnv fleets + device infeed + the fused HBM
+    learner, all contending for the one device — reports BOTH north-star
+    metrics (learner steps/s AND actor FPS) from the same run."""
+    from ape_x_dqn_tpu.config import ApexConfig
+    from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
+    from ape_x_dqn_tpu.utils.metrics import MetricLogger
+
+    cfg = ApexConfig()
+    cfg.network = "conv"
+    cfg.env.name = "random:84x84x1"
+    cfg.actor.num_actors = num_actors   # one fleet: batched policy steps
+    cfg.actor.T = 10_000_000
+    cfg.actor.flush_every = 16
+    cfg.actor.sync_every = 500
+    cfg.learner.device_replay = True
+    cfg.learner.sample_ahead = True
+    cfg.learner.steps_per_call = steps_per_call
+    # Publish cadence: each publish is a full param device_get through the
+    # tunnel (~13 MB) that also drains the device queue — at the reference's
+    # per-step-minded default (10) it would fire once per fused call and
+    # dominate the learner's wall clock.
+    cfg.learner.publish_every = publish_every
+    cfg.learner.min_replay_mem_size = 20_000
+    cfg.learner.optimizer = "rmsprop"
+    cfg.learner.max_grad_norm = None
+    cfg.learner.second_moment_dtype = "bfloat16"
+    cfg.learner.target_dtype = "bfloat16"
+    cfg.learner.total_steps = learner_steps
+    cfg.replay.capacity = 100_000
+    devnull = open(os.devnull, "w")
+    logger = MetricLogger(stream=devnull)
+    pipe = AsyncPipeline(cfg, logger=logger, log_every=1_000_000)
+    t0 = time.perf_counter()
+    try:
+        result = pipe.run(learner_steps=learner_steps, warmup_timeout=300.0)
+    finally:
+        wall = time.perf_counter() - t0
+        devnull.close()
+    assert np.isfinite(result["learner/loss"]), result
+    return {
+        "learner_steps_per_sec": round(result["step"] / wall, 1),
+        "actor_fps": round(result["actor_steps"] / wall, 1),
+        "learner_steps": result["step"],
+        "actor_steps": result["actor_steps"],
+        "wall_s": round(wall, 1),
+        "window_steps_per_sec": result["steps_per_sec"],
+        "window_actor_fps": result["actor_fps"],
+        "config": {
+            "num_actors": cfg.actor.num_actors,
+            "env": cfg.env.name,
+            "steps_per_call": cfg.learner.steps_per_call,
+            "publish_every": cfg.learner.publish_every,
+            "note": (
+                "whole-run averages incl. warmup-to-20k and compiles; "
+                "window_* are the final 30s sliding-window rates "
+                "(the steady-state numbers)"
+            ),
+        },
+    }
+
+
+def _actor_solo_bench(fleet_steps: int = 192, num_actors: int = 512) -> dict:
+    """Uncontended actor FPS: one batched fleet stepping RandomFrameEnv with
+    jitted policy forwards and the full n-step/priority emission path, no
+    learner sharing the device — the actor-side capability ceiling."""
+    import jax
+
+    from ape_x_dqn_tpu.actors import ActorFleet, LocalParamSource
+    from ape_x_dqn_tpu.envs import RandomFrameEnv
+    from ape_x_dqn_tpu.models.dueling import build_network
+
+    net = build_network("conv", 4)
+    fleet = ActorFleet(
+        [lambda: RandomFrameEnv((84, 84, 1), num_actions=4)] * num_actors,
+        net, n_step=3, flush_every=16,
+    )
+    params = net.init(
+        jax.random.PRNGKey(0), np.zeros((1, 84, 84, 1), np.uint8)
+    )
+    fleet.sync_params(LocalParamSource(params))
+    fleet.collect(32)  # compile + warm
+    t0 = time.perf_counter()
+    chunks, _ = fleet.collect(fleet_steps)
+    dt = time.perf_counter() - t0
+    emitted = sum(c.transitions.action.shape[0] for c in chunks)
+    return {
+        "actor_fps": round(fleet_steps * num_actors / dt, 1),
+        "fleet_steps_per_sec": round(fleet_steps / dt, 1),
+        "num_actors": num_actors,
+        "transitions_emitted": emitted,
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps-per-call", type=int, default=2048)
@@ -131,6 +228,12 @@ def main() -> None:
         "--skip-sampler-validation", action="store_true",
         help="skip the 2M-slot sampler parity check (saves ~30s)",
     )
+    parser.add_argument(
+        "--skip-pipeline", action="store_true",
+        help="skip the end-to-end async-pipeline run (actors + infeed + "
+        "fused learner contending on the chip; ~90s)",
+    )
+    parser.add_argument("--pipeline-steps", type=int, default=20_000)
     args = parser.parse_args()
 
     import jax
@@ -228,6 +331,19 @@ def main() -> None:
     }
     if not args.skip_sampler_validation:
         extra["samplers_2m"] = _validate_samplers(rng)
+    if not args.skip_pipeline:
+        extra["actor_solo"] = _actor_solo_bench()
+        extra["pipeline"] = _pipeline_bench(args.pipeline_steps)
+        # Second north-star metric: actor FPS.  The solo number is the
+        # capability ceiling; the contended pipeline numbers show what one
+        # tunneled chip sustains with the learner sharing the device FIFO
+        # (PROFILE.md "pipeline contention" section).
+        extra["actor_fps"] = extra["actor_solo"]["actor_fps"]
+        extra["pipeline"]["contention_note"] = (
+            "every host sync charges ~140 ms to the next dispatch on this "
+            "tunneled platform, so concurrent actor+learner dispatch "
+            "cannot interleave at us granularity; see PROFILE.md"
+        )
 
     print(
         json.dumps(

