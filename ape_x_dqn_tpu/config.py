@@ -119,6 +119,10 @@ class ReplayConfig:
     capacity: int = 100_000               # parameters.json:28 soft_capacity
     priority_exponent: float = 0.6        # parameters.json:29
     is_exponent: float = 0.4              # parameters.json:30 (dead there, live here)
+    # zlib-compress stored frames in the HOST replay (the reference's own
+    # README TODO, reference README.md:24) — a memory/CPU trade for big
+    # buffers; no effect on the HBM device replay (learner.device_replay).
+    frame_compression: bool = False
 
 
 @dataclasses.dataclass

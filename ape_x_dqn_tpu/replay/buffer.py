@@ -29,12 +29,102 @@ O(steps) — the discipline that keeps the central replay off the critical path
 from __future__ import annotations
 
 import threading
+import zlib
 from typing import Optional
 
 import numpy as np
 
 from ape_x_dqn_tpu.replay.sum_tree import SumTree
 from ape_x_dqn_tpu.types import NStepTransition, PrioritizedBatch
+
+
+class RawFrameStore:
+    """Preallocated ndarray frame storage — the default.
+
+    The encode/put_encoded split exists so ``PrioritizedReplay.add`` can do
+    any per-frame work (a no-op here; deflate for the compressed store)
+    OUTSIDE the replay lock.
+    """
+
+    compressed = False
+
+    def __init__(self, capacity: int, frame_shape, dtype=np.uint8):
+        self._arr = np.zeros((capacity, *frame_shape), dtype=dtype)
+
+    def encode(self, frames: np.ndarray):
+        return frames
+
+    def put_encoded(self, idx: np.ndarray, encoded) -> None:
+        self._arr[idx] = encoded
+
+    def put(self, idx: np.ndarray, frames: np.ndarray) -> None:
+        self.put_encoded(idx, self.encode(frames))
+
+    def get(self, idx: np.ndarray) -> np.ndarray:
+        # Advanced indexing already allocates a fresh array — no copy.
+        return self._arr[idx]
+
+    def nbytes(self) -> int:
+        return self._arr.nbytes
+
+
+class CompressedFrameStore:
+    """Per-slot zlib-compressed frame storage — the reference's own README
+    TODO ("compressing the frames", reference README.md:24) as an opt-in
+    memory/CPU trade (SURVEY §7 stage-4 memory option).
+
+    Structured frames (Atari-like) compress 3-10×; the cost is one
+    deflate per stored frame (off-lock, via ``encode``) and one inflate per
+    sampled row, on the host path only (the HBM device replay is
+    unaffected).  Level 1 is the right spot: >90% of the ratio at a
+    fraction of level 6's CPU.
+    """
+
+    compressed = True
+
+    def __init__(self, capacity: int, frame_shape, dtype=np.uint8, level: int = 1):
+        self._slots: list = [None] * capacity
+        self.shape = tuple(frame_shape)
+        self.dtype = np.dtype(dtype)
+        self.level = int(level)
+
+    def encode(self, frames: np.ndarray) -> list:
+        frames = np.asarray(frames, self.dtype)
+        return [zlib.compress(frames[i].tobytes(), self.level)
+                for i in range(frames.shape[0])]
+
+    def put_encoded(self, idx: np.ndarray, encoded: list) -> None:
+        for i, k in enumerate(idx):
+            self._slots[int(k)] = encoded[i]
+
+    def put(self, idx: np.ndarray, frames: np.ndarray) -> None:
+        self.put_encoded(idx, self.encode(frames))
+
+    def get(self, idx: np.ndarray) -> np.ndarray:
+        out = np.empty((len(idx), *self.shape), self.dtype)
+        for i, k in enumerate(idx):
+            out[i] = np.frombuffer(
+                zlib.decompress(self._slots[int(k)]), self.dtype
+            ).reshape(self.shape)
+        return out
+
+    def export_blobs(self, size: int) -> tuple:
+        """(blob uint8 [sum lens], lens int64 [size]) — the deflated slots
+        verbatim, so snapshots never materialize the dense buffer (the
+        whole point of this store is that the dense form doesn't fit)."""
+        blobs = self._slots[:size]
+        lens = np.array([len(b) for b in blobs], np.int64)
+        return np.frombuffer(b"".join(blobs), np.uint8).copy(), lens
+
+    def import_blobs(self, blob: np.ndarray, lens: np.ndarray) -> None:
+        raw = blob.tobytes()
+        off = 0
+        for i, n in enumerate(lens):
+            self._slots[i] = raw[off:off + int(n)]
+            off += int(n)
+
+    def nbytes(self) -> int:
+        return sum(len(s) for s in self._slots if s is not None)
 
 
 class PrioritizedReplay:
@@ -58,6 +148,7 @@ class PrioritizedReplay:
         priority_exponent: float = 0.6,
         obs_dtype=np.uint8,
         sum_tree_cls=None,
+        frame_compression: bool = False,
     ):
         if sum_tree_cls is None:
             from ape_x_dqn_tpu.replay.native import default_sum_tree_cls
@@ -67,8 +158,9 @@ class PrioritizedReplay:
             raise ValueError("capacity must be positive")
         self.capacity = int(capacity)
         self.alpha = float(priority_exponent)
-        self._obs = np.zeros((capacity, *obs_shape), dtype=obs_dtype)
-        self._next_obs = np.zeros((capacity, *obs_shape), dtype=obs_dtype)
+        store_cls = CompressedFrameStore if frame_compression else RawFrameStore
+        self._obs = store_cls(capacity, obs_shape, obs_dtype)
+        self._next_obs = store_cls(capacity, obs_shape, obs_dtype)
         self._action = np.zeros((capacity,), dtype=np.int32)
         self._reward = np.zeros((capacity,), dtype=np.float32)
         self._discount = np.zeros((capacity,), dtype=np.float32)
@@ -92,10 +184,15 @@ class PrioritizedReplay:
             return np.zeros((0,), np.int64)
         if n > self.capacity:
             raise ValueError(f"batch of {n} exceeds capacity {self.capacity}")
+        # Per-frame encode work (deflate, for the compressed store) happens
+        # OFF the lock — an 8k-row actor flush must not stall the learner's
+        # sample() for its compression time.
+        enc_obs = self._obs.encode(batch.obs)
+        enc_next_obs = self._next_obs.encode(batch.next_obs)
         with self._lock:
             idx = (self._cursor + np.arange(n)) % self.capacity
-            self._obs[idx] = batch.obs
-            self._next_obs[idx] = batch.next_obs
+            self._obs.put_encoded(idx, enc_obs)
+            self._next_obs.put_encoded(idx, enc_next_obs)
             self._action[idx] = batch.action
             self._reward[idx] = batch.reward
             self._discount[idx] = batch.discount
@@ -127,11 +224,11 @@ class PrioritizedReplay:
             mass = self._tree.get(idx)
             total = self._tree.total
             transition = NStepTransition(
-                obs=self._obs[idx].copy(),
+                obs=self._obs.get(idx),
                 action=self._action[idx].copy(),
                 reward=self._reward[idx].copy(),
                 discount=self._discount[idx].copy(),
-                next_obs=self._next_obs[idx].copy(),
+                next_obs=self._next_obs.get(idx),
             )
         probs = mass / total
         weights = np.power(size * np.maximum(probs, 1e-12), -beta)
@@ -172,6 +269,12 @@ class PrioritizedReplay:
     def total_added(self) -> int:
         return self._count
 
+    def frames_nbytes(self) -> int:
+        """Bytes held by frame storage (compressed stores report the
+        deflated size — the observable for the memory win)."""
+        with self._lock:
+            return self._obs.nbytes() + self._next_obs.nbytes()
+
     def max_priority(self) -> float:
         with self._lock:
             m = self._tree.max_priority()
@@ -185,9 +288,7 @@ class PrioritizedReplay:
         with self._lock:
             size = min(self._count, self.capacity)
             idx = np.arange(size)
-            return {
-                "obs": self._obs[:size].copy(),
-                "next_obs": self._next_obs[:size].copy(),
+            out = {
                 "action": self._action[:size].copy(),
                 "reward": self._reward[:size].copy(),
                 "discount": self._discount[:size].copy(),
@@ -195,10 +296,26 @@ class PrioritizedReplay:
                 "cursor": self._cursor,
                 "count": self._count,
             }
+            if self._obs.compressed:
+                # Snapshot the deflated slots verbatim: a 2M-slot compressed
+                # buffer must never materialize its ~28 GB dense form just
+                # to checkpoint (that's why compression was configured).
+                out["obs_blob"], out["obs_lens"] = self._obs.export_blobs(size)
+                out["next_obs_blob"], out["next_obs_lens"] = (
+                    self._next_obs.export_blobs(size)
+                )
+            else:
+                out["obs"] = self._obs.get(idx)
+                out["next_obs"] = self._next_obs.get(idx)
+            return out
 
     def load_state_dict(self, state: dict) -> None:
+        compressed_snap = "obs_blob" in state
         with self._lock:
-            size = state["obs"].shape[0]
+            size = (
+                state["obs_lens"].shape[0] if compressed_snap
+                else state["obs"].shape[0]
+            )
             if size > self.capacity:
                 raise ValueError("snapshot larger than capacity")
             # Clear everything first so a restore into a warm buffer cannot
@@ -206,8 +323,24 @@ class PrioritizedReplay:
             self._tree.set(
                 np.arange(self.capacity), np.zeros(self.capacity, np.float64)
             )
-            self._obs[:size] = state["obs"]
-            self._next_obs[:size] = state["next_obs"]
+            rng = np.arange(size)
+            if compressed_snap and self._obs.compressed:
+                self._obs.import_blobs(state["obs_blob"], state["obs_lens"])
+                self._next_obs.import_blobs(
+                    state["next_obs_blob"], state["next_obs_lens"]
+                )
+            elif compressed_snap:
+                # Cross-restore into a raw store: inflate through a scratch
+                # compressed view.
+                tmp = CompressedFrameStore(size, self._obs._arr.shape[1:],
+                                           self._obs._arr.dtype)
+                tmp.import_blobs(state["obs_blob"], state["obs_lens"])
+                self._obs.put(rng, tmp.get(rng))
+                tmp.import_blobs(state["next_obs_blob"], state["next_obs_lens"])
+                self._next_obs.put(rng, tmp.get(rng))
+            else:
+                self._obs.put(rng, state["obs"])
+                self._next_obs.put(rng, state["next_obs"])
             self._action[:size] = state["action"]
             self._reward[:size] = state["reward"]
             self._discount[:size] = state["discount"]

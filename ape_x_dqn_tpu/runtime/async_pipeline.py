@@ -43,6 +43,7 @@ from ape_x_dqn_tpu.runtime.components import build_components
 from ape_x_dqn_tpu.runtime.infeed import PrefetchQueue
 from ape_x_dqn_tpu.runtime.param_store import ParamStore
 from ape_x_dqn_tpu.utils.metrics import MetricLogger, RateCounter
+from ape_x_dqn_tpu.utils.profiling import StageTimer
 
 
 class _ActorWorker:
@@ -147,6 +148,9 @@ class AsyncPipeline:
         # fleet is ~8k transitions), so narrow windows see 0 or 1 bursts.
         self._fps = RateCounter(window_s=30.0)
         self._steps_rate = RateCounter(window_s=30.0)
+        # Per-stage wall-clock accumulators (SURVEY §5 tracing subsystem):
+        # µs/step per pipeline stage, exported in every metrics emit.
+        self.timers = StageTimer()
         self._prefetch_depth = prefetch_depth
         # Device-queue fairness (fused mode): with no cap the learner
         # enqueues K-step programs back-to-back and every actor policy_step
@@ -267,8 +271,10 @@ class AsyncPipeline:
                 metrics = None
                 state = self.comps.state
                 while self._learner_step < target and not self.stop_event.is_set():
-                    host_indices, batch = queue.get()
-                    state, metrics = self.train_step(state, batch)
+                    with self.timers.stage("sample+place"):
+                        host_indices, batch = queue.get()
+                    with self.timers.stage("step_dispatch"):
+                        state, metrics = self.train_step(state, batch)
                     # Keep the live state visible on self so a mid-run
                     # exception never strands an advanced step counter with
                     # stale params (a ref assignment, no device sync).
@@ -280,12 +286,14 @@ class AsyncPipeline:
                     # behind the current dispatch), never blocking on the
                     # step just launched.
                     if pending is not None:
-                        self.comps.replay.update_priorities(
-                            pending[0], np.asarray(pending[1])
-                        )
+                        with self.timers.stage("priority_writeback"):
+                            self.comps.replay.update_priorities(
+                                pending[0], np.asarray(pending[1])
+                            )
                     pending = (host_indices, metrics.priorities)
                     if self._learner_step % cfg.learner.publish_every == 0:
-                        self.store.publish(state.params)
+                        with self.timers.stage("publish"):
+                            self.store.publish(state.params)
                     if (
                         cfg.learner.checkpoint_every
                         and self._learner_step % cfg.learner.checkpoint_every == 0
@@ -340,18 +348,21 @@ class AsyncPipeline:
                 else None
             )
             while self._learner_step < target and not self.stop_event.is_set():
-                fused.ingest_staged(drain=self.worker.finished)
+                with self.timers.stage("ingest"):
+                    fused.ingest_staged(drain=self.worker.finished)
                 beta = beta_schedule(
                     self._learner_step, cfg.learner.total_steps,
                     cfg.replay.is_exponent,
                 )
-                last_metrics = fused.train(beta)
+                with self.timers.stage("fused_dispatch"):
+                    last_metrics = fused.train(beta)
                 inflight.append(last_metrics)
                 if len(inflight) >= self._fused_inflight:
                     # Force the oldest call's completion with one tiny host
                     # read (block_until_ready is a no-op on tunneled
                     # platforms — see bench.py methodology note).
-                    float(np.asarray(inflight.pop(0).loss[-1]))
+                    with self.timers.stage("force_oldest"):
+                        float(np.asarray(inflight.pop(0).loss[-1]))
                 self._learner_step += fused.steps_per_call
                 self._steps_rate.add(fused.steps_per_call)
                 self.comps.state = fused.state
@@ -361,7 +372,8 @@ class AsyncPipeline:
                 if self._learner_step % max(
                     cfg.learner.publish_every, fused.steps_per_call
                 ) < fused.steps_per_call:
-                    self.store.publish(fused.params_for_publish())
+                    with self.timers.stage("publish"):
+                        self.store.publish(fused.params_for_publish())
                 if next_ckpt is not None and self._learner_step >= next_ckpt:
                     from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
 
@@ -405,6 +417,7 @@ class AsyncPipeline:
             param_version=self.store.version,
             actor_restarts=self.worker.restarts,
             actor_heartbeat_age=round(time.monotonic() - self.worker.heartbeat, 3),
+            stage_us=self.timers.us_per_call(),
             final=final,
         )
 
@@ -439,5 +452,6 @@ class AsyncPipeline:
             param_version=self.store.version,
             actor_restarts=self.worker.restarts,
             actor_heartbeat_age=round(time.monotonic() - self.worker.heartbeat, 3),
+            stage_us=self.timers.us_per_call(),
             final=final,
         )

@@ -206,6 +206,7 @@ def build_components(cfg: ApexConfig) -> Components:
         replay = PrioritizedReplay(
             cfg.replay.capacity, obs_shape,
             priority_exponent=cfg.replay.priority_exponent,
+            frame_compression=cfg.replay.frame_compression,
         )
     learner_step = 0
     restored_path = None

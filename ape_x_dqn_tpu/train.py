@@ -46,6 +46,17 @@ def build_argparser() -> argparse.ArgumentParser:
     )
     p.add_argument("--metrics-file", default=None, help="also write JSONL here")
     p.add_argument("--log-every", type=int, default=500)
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax.profiler device trace of the run into this dir "
+        "(TensorBoard-viewable); degrades to a warning on platforms whose "
+        "profiler plugin cannot trace",
+    )
+    p.add_argument(
+        "--profile-port", type=int, default=None,
+        help="start the live jax.profiler server on this port "
+        "(attach with TensorBoard's profile tab)",
+    )
     return p
 
 
@@ -54,6 +65,20 @@ def main(argv=None) -> int:
     cfg = load_config(args.params_file, overrides=args.overrides)
     print("config:", to_dict(cfg), file=sys.stderr)
     logger = MetricLogger(stream=sys.stdout, path=args.metrics_file)
+    import contextlib
+
+    from ape_x_dqn_tpu.utils.profiling import start_server, trace
+
+    if args.profile_port is not None:
+        start_server(args.profile_port)
+    profile_ctx = (
+        trace(args.profile_dir) if args.profile_dir else contextlib.nullcontext()
+    )
+    with profile_ctx:
+        return _run(args, cfg, logger)
+
+
+def _run(args, cfg, logger) -> int:
     if args.mode == "async":
         from ape_x_dqn_tpu.runtime import AsyncPipeline
 

@@ -14,7 +14,7 @@ Three tools, smallest-first:
   * ``subtractive_timing`` — the measurement pattern that actually works on
     this platform (per-op traces don't cross the tunnel): time K-step fused
     program *variants* with stages deleted; the difference isolates each
-    stage's device cost.  Used by ``bench.py --profile`` to produce
+    stage's device cost.  Used by ``tools/profile_fused.py`` to produce
     PROFILE.md.
 
 The reference has no profiling at all (``time`` is imported in its

@@ -61,3 +61,73 @@ def test_reference_params_file(tmp_path, capsys):
 def test_bad_override_exits_with_error():
     with pytest.raises(ValueError):
         main(BASE_ARGS + ["--set", "bogus.key=1", "--steps", "1"])
+
+
+def test_canonical_configs_load_and_validate():
+    """The five BASELINE.md canonical configs parse, validate, and carry the
+    runtime modes they claim (device replay, data parallel, process actors,
+    frame compression)."""
+    import glob
+    import os
+
+    from ape_x_dqn_tpu.config import load_config
+
+    root = os.path.join(os.path.dirname(__file__), "..", "configs")
+    paths = sorted(glob.glob(os.path.join(root, "*.json")))
+    assert len(paths) == 5, paths
+    cfgs = {os.path.basename(p): load_config(p) for p in paths}
+    assert cfgs["config1_pong_1actor.json"].actor.num_actors == 1
+    assert cfgs["config2_breakout_8actors.json"].actor.num_actors == 8
+    c3 = cfgs["config3_seaquest_256actors_2m.json"]
+    assert c3.replay.capacity == 2_000_000
+    assert c3.learner.device_replay and c3.learner.sample_ahead
+    assert c3.actor.mode == "process"
+    c4 = cfgs["config4_dp_v4_8_512actors.json"]
+    assert c4.learner.data_parallel == 4 and c4.actor.num_actors == 512
+    assert c4.replay.frame_compression
+    c5 = cfgs["config5_sweep_atari57_base.json"]
+    assert c5.learner.device_replay
+
+
+def test_sweep_runner_shared_schedule(tmp_path):
+    """tools/sweep.py (BASELINE config 5's runner): one run per game under
+    one shared schedule, summary JSONL written, bad games don't kill it."""
+    import json
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent / "tools"))
+    try:
+        import sweep
+    finally:
+        sys.path.pop(0)
+
+    out = tmp_path / "sweep.jsonl"
+    results = sweep.run_sweep(
+        ["chain:5", "catch", "definitely-not-an-env"],
+        steps=20,
+        mode="sync",
+        out_path=str(out),
+        overrides=[
+            "network=mlp", "actor.num_actors=2", "actor.T=100000",
+            "learner.min_replay_mem_size=64", "replay.capacity=1024",
+        ],
+    )
+    assert [r["status"] for r in results] == ["ok", "ok", "error"]
+    assert results[0]["game"] == "chain:5"
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == 3
+    # Shared schedule, distinct seeds per game.
+    assert lines[0]["seed"] != lines[1]["seed"]
+
+
+def test_sweep_atari57_list():
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent / "tools"))
+    try:
+        import sweep
+    finally:
+        sys.path.pop(0)
+    games = sweep.game_list("atari57")
+    assert len(games) == 57
+    assert "PongNoFrameskip-v4" in games and "ZaxxonNoFrameskip-v4" in games

@@ -134,6 +134,10 @@ class TestAsyncPipeline:
         assert pipe.worker.restarts == 0
         # Learner state advanced and actors saw published params.
         assert int(pipe.comps.state.step) == 150
+        # Per-stage timers exported (SURVEY §5 tracing subsystem).
+        assert "sample+place" in final["stage_us"]
+        assert "step_dispatch" in final["stage_us"]
+        assert final["stage_us"]["step_dispatch"] > 0
 
     def test_priorities_written_back(self):
         pipe = AsyncPipeline(pipeline_config(), logger=MetricLogger(stream=io.StringIO()))

@@ -1,0 +1,126 @@
+"""Multi-env sweep runner — BASELINE.md canonical config 5 ("Atari-57
+multi-env sweep: per-game actor pools, shared learner schedule").
+
+Runs one training job per environment with a SHARED learner schedule (one
+base config; only ``env.name`` and the seed vary per game), collecting each
+run's final metrics record into a summary JSONL.  The reference has no
+sweep tooling at all (its one config file names one game — reference
+parameters.json:5, SURVEY §2 component 9).
+
+Usage:
+    python tools/sweep.py --base configs/sweep_atari57_base.json \
+        --games atari57 --out sweep_results.jsonl
+    python tools/sweep.py --games chain:6,catch --steps 200 --mode sync
+
+``--games`` takes a comma-separated list of env specs, or the name of a
+built-in list (``atari57``).  Each game runs in-process sequentially (the
+learner owns the accelerator; parallel sweeps belong on separate hosts —
+point N invocations at disjoint ``--games`` slices).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+# The canonical 57-game Ape-X/Rainbow Atari suite (NoFrameskip-v4 ids).
+ATARI_57 = [
+    "Alien", "Amidar", "Assault", "Asterix", "Asteroids", "Atlantis",
+    "BankHeist", "BattleZone", "BeamRider", "Berzerk", "Bowling", "Boxing",
+    "Breakout", "Centipede", "ChopperCommand", "CrazyClimber", "Defender",
+    "DemonAttack", "DoubleDunk", "Enduro", "FishingDerby", "Freeway",
+    "Frostbite", "Gopher", "Gravitar", "Hero", "IceHockey", "Jamesbond",
+    "Kangaroo", "Krull", "KungFuMaster", "MontezumaRevenge", "MsPacman",
+    "NameThisGame", "Phoenix", "Pitfall", "Pong", "PrivateEye", "Qbert",
+    "Riverraid", "RoadRunner", "Robotank", "Seaquest", "Skiing", "Solaris",
+    "SpaceInvaders", "StarGunner", "Surround", "Tennis", "TimePilot",
+    "Tutankham", "UpNDown", "Venture", "VideoPinball", "WizardOfWor",
+    "YarsRevenge", "Zaxxon",
+]
+
+
+def game_list(spec: str) -> list[str]:
+    if spec == "atari57":
+        return [f"{g}NoFrameskip-v4" for g in ATARI_57]
+    return [g.strip() for g in spec.split(",") if g.strip()]
+
+
+def run_sweep(
+    games: list[str],
+    base: str | None = None,
+    steps: int | None = None,
+    mode: str = "async",
+    out_path: str | None = None,
+    overrides: list[str] = (),
+    seed0: int = 0,
+) -> list[dict]:
+    """One training run per game under the shared schedule; returns (and
+    optionally writes) one summary record per game."""
+    from ape_x_dqn_tpu.config import load_config, to_dict
+    from ape_x_dqn_tpu.utils.metrics import MetricLogger
+
+    out = open(out_path, "a") if out_path else None
+    results = []
+    for i, game in enumerate(games):
+        cfg = load_config(base, overrides=list(overrides))
+        cfg.env.name = game
+        cfg.seed = seed0 + i
+        cfg.validate()
+        t0 = time.time()
+        record: dict = {"game": game, "seed": cfg.seed}
+        try:
+            logger = MetricLogger(stream=sys.stderr)
+            if mode == "async":
+                from ape_x_dqn_tpu.runtime import AsyncPipeline
+
+                pipe = AsyncPipeline(cfg, logger=logger, log_every=10_000)
+                final = pipe.run(learner_steps=steps)
+            else:
+                from ape_x_dqn_tpu.runtime import SingleProcessDriver
+
+                driver = SingleProcessDriver(cfg)
+                iters = driver.run(learner_steps=steps)
+                final = iters[-1]._asdict() if iters else {}
+                final.pop("episodes", None)
+            record.update(final=final, status="ok")
+        except Exception as e:  # noqa: BLE001 — a sweep survives bad games
+            record.update(status="error", error=f"{type(e).__name__}: {e}")
+        record["wall_s"] = round(time.time() - t0, 1)
+        results.append(record)
+        line = json.dumps(record)
+        print(line)
+        if out:
+            out.write(line + "\n")
+            out.flush()
+    if out:
+        out.close()
+    return results
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--base", default=None, help="base config JSON (shared schedule)")
+    p.add_argument("--games", required=True,
+                   help="comma-separated env specs, or 'atari57'")
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--mode", choices=("async", "sync"), default="async")
+    p.add_argument("--out", default=None, help="summary JSONL path")
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   metavar="PATH=VALUE")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    results = run_sweep(
+        game_list(args.games), base=args.base, steps=args.steps,
+        mode=args.mode, out_path=args.out, overrides=args.overrides,
+        seed0=args.seed,
+    )
+    failed = [r for r in results if r["status"] != "ok"]
+    print(f"sweep done: {len(results) - len(failed)}/{len(results)} ok",
+          file=sys.stderr)
+    return 1 if len(failed) == len(results) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
