@@ -1,6 +1,12 @@
-"""Parallelism layer: device mesh, shardings, data-parallel learner step."""
+"""Parallelism layer: device mesh, shardings, data-parallel learner step,
+multi-host initialization."""
 
 from ape_x_dqn_tpu.parallel.dp import build_sharded_train_step, place_batch
+from ape_x_dqn_tpu.parallel.multihost import (
+    host_value,
+    initialize_multihost,
+    local_shard,
+)
 from ape_x_dqn_tpu.parallel.mesh import (
     batch_sharding,
     infer_param_sharding,
@@ -14,7 +20,10 @@ from ape_x_dqn_tpu.parallel.mesh import (
 __all__ = [
     "batch_sharding",
     "build_sharded_train_step",
+    "host_value",
     "infer_param_sharding",
+    "initialize_multihost",
+    "local_shard",
     "make_mesh",
     "place_batch",
     "place_state",

@@ -76,6 +76,29 @@ def build_sharded_train_step(
 
 
 def place_batch(batch: PrioritizedBatch, mesh: Mesh) -> PrioritizedBatch:
-    """Shard a host batch over the mesh's data axis (leading dim)."""
+    """Shard a host batch over the mesh's data axis (leading dim).
+
+    Single-process spelling: the caller holds the FULL batch.  Multi-host
+    SPMD uses :func:`place_local_batch` (each process holds only its rows).
+    """
     sh = batch_sharding(mesh)
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
+
+
+def place_local_batch(local_batch: PrioritizedBatch, mesh: Mesh) -> PrioritizedBatch:
+    """Assemble the GLOBAL data-sharded batch from per-process local rows.
+
+    Multi-host: every process passes its own ``B / process_count`` rows
+    (sampled from its local replay); ``make_array_from_process_local_data``
+    lays each process's rows onto its addressable shards, so global row
+    order is process order — the inverse of ``multihost.local_shard``,
+    which is what makes the per-host priority writeback line up with the
+    per-host sample indices.
+    """
+    import numpy as np
+
+    sh = batch_sharding(mesh)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sh, np.asarray(x)),
+        local_batch,
+    )

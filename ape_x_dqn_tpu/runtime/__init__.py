@@ -1,16 +1,29 @@
-"""Runtimes: deterministic single-process driver + async pipeline."""
+"""Runtimes: deterministic single-process driver + async pipeline +
+process-parallel actor workers."""
 
 from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
 from ape_x_dqn_tpu.runtime.components import Components, build_components
+from ape_x_dqn_tpu.runtime.fused_learner import FusedDeviceLearner
 from ape_x_dqn_tpu.runtime.infeed import PrefetchQueue
 from ape_x_dqn_tpu.runtime.param_store import ParamStore
+from ape_x_dqn_tpu.runtime.process_actors import (
+    ProcessActorPool,
+    ProcessActorWorker,
+    SharedMemoryParamStore,
+    SharedParamBuffer,
+)
 from ape_x_dqn_tpu.runtime.single_process import SingleProcessDriver, beta_schedule
 
 __all__ = [
     "AsyncPipeline",
     "Components",
+    "FusedDeviceLearner",
     "ParamStore",
     "PrefetchQueue",
+    "ProcessActorPool",
+    "ProcessActorWorker",
+    "SharedMemoryParamStore",
+    "SharedParamBuffer",
     "SingleProcessDriver",
     "beta_schedule",
     "build_components",

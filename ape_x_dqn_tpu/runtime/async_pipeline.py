@@ -52,7 +52,7 @@ class _ActorWorker:
     def __init__(self, comps, store: ParamStore, stop: threading.Event,
                  logger: MetricLogger, fps: RateCounter,
                  max_restarts: int = 3, quantum: Optional[int] = None,
-                 sink=None):
+                 sink=None, seed_base: int = 0):
         self._comps = comps
         self._store = store
         self._stop = stop
@@ -67,6 +67,11 @@ class _ActorWorker:
             lambda prio, trans: comps.replay.add(prio, trans)
         )
         self.restarts = 0
+        # Fleet seed base: nonzero under multi-host SPMD so each host's
+        # actors explore distinct streams while the MODEL seed (cfg.seed)
+        # stays identical everywhere — replicated param placement asserts
+        # cross-process equality.
+        self._seed_base = seed_base
         self.finished = False  # clean exit (actor.T reached), not a crash
         self.heartbeat = time.monotonic()
         self.episodes: List[EpisodeStat] = []
@@ -97,7 +102,9 @@ class _ActorWorker:
         while not self._stop.is_set():
             fleet = None
             try:
-                fleet = self._comps.make_fleet(seed_offset=self.restarts)
+                fleet = self._comps.make_fleet(
+                    seed_offset=self._seed_base + self.restarts
+                )
                 fleet.sync_params(self._store)
                 self._run_fleet(fleet, self._comps.cfg.actor.T - steps_done)
                 # Distinguish "actor.T exhausted" from "told to stop".
@@ -161,6 +168,8 @@ class AsyncPipeline:
         self._fused_inflight = max(1, int(fused_inflight))
         self.fused = None
         self.mesh = None
+        self._n_proc = 1       # SPMD process count (multi-host)
+        self._proc_idx = 0
         sink = None
         if self.cfg.learner.device_replay:
             self.fused = self.comps.make_fused_learner()
@@ -178,10 +187,26 @@ class AsyncPipeline:
             # Mesh data-parallel learner (BASELINE.md config 4): the same
             # loop below, with the step jitted over the mesh, infeed batches
             # sharded in _place, and the replicated params published as-is.
+            # Under multi-host SPMD (jax.distributed initialized, every
+            # host running this same program) the mesh spans all hosts'
+            # devices: each host samples its B/n share from its LOCAL
+            # replay, the global batch assembles host rows onto host
+            # devices (parallel.place_local_batch — no cross-host batch
+            # traffic), the all-reduce crosses DCN inside the step, and
+            # each host restamps only its own priority rows.
+            import jax
+
             self.train_step, sharded_state, self.mesh = (
                 self.comps.make_sharded_train_step()
             )
             self.comps.state = sharded_state
+            self._n_proc = jax.process_count()
+            self._proc_idx = jax.process_index()
+            if self.cfg.learner.replay_sample_size % self._n_proc:
+                raise ValueError(
+                    "learner.replay_sample_size must divide by "
+                    f"jax.process_count()={self._n_proc}"
+                )
         else:
             self.train_step = self.comps.make_train_step()
         if self.cfg.actor.mode == "process":
@@ -209,16 +234,23 @@ class AsyncPipeline:
                 stop_event=self.stop_event,
             )
         else:
-            self.store = ParamStore(self.comps.state.params)
+            self.store = ParamStore(self._params_host(self.comps.state.params))
             self.worker = _ActorWorker(
                 self.comps, self.store, self.stop_event, self.logger,
                 self._fps, max_restarts=max_actor_restarts, sink=sink,
+                seed_base=self._proc_idx * 7919,
             )
         self._learner_step = self.comps.learner_step
-        self._sample = (
-            None if self.fused is not None
-            else self.comps.make_sampler(lambda: self._learner_step)
-        )
+        if self.fused is not None:
+            self._sample = None
+        else:
+            self._sample = self.comps.make_sampler(
+                lambda: self._learner_step,
+                sample_size=(
+                    self.cfg.learner.replay_sample_size // self._n_proc
+                ),
+                rng_salt=self._proc_idx * 7919,
+            )
         self.episode_returns: List[float] = []
 
     @property
@@ -288,27 +320,29 @@ class AsyncPipeline:
                     if pending is not None:
                         with self.timers.stage("priority_writeback"):
                             self.comps.replay.update_priorities(
-                                pending[0], np.asarray(pending[1])
+                                pending[0], self._priorities_host(pending[1])
                             )
                     pending = (host_indices, metrics.priorities)
                     if self._learner_step % cfg.learner.publish_every == 0:
                         with self.timers.stage("publish"):
-                            self.store.publish(state.params)
+                            self.store.publish(self._params_host(state.params))
                     if (
                         cfg.learner.checkpoint_every
                         and self._learner_step % cfg.learner.checkpoint_every == 0
+                        and self._proc_idx == 0  # one writer per checkpoint
                     ):
                         from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
 
                         save_checkpoint(
-                            cfg.learner.checkpoint_dir, state,
+                            cfg.learner.checkpoint_dir,
+                            self._params_host(state),
                             replay=self.comps.replay,
                         )
                     if self._learner_step % self.log_every == 0:
                         self._emit(metrics)
                 if pending is not None:
                     self.comps.replay.update_priorities(
-                        pending[0], np.asarray(pending[1])
+                        pending[0], self._priorities_host(pending[1])
                     )
         finally:
             self.stop_event.set()
@@ -424,15 +458,46 @@ class AsyncPipeline:
     def _place(self, host_batch):
         """Stage a host batch on device — sharded over the mesh's data axis
         in data-parallel mode — keeping host indices for the deferred
-        priority write-back."""
+        priority write-back.  Multi-host: this host's rows only, assembled
+        into the global batch (parallel.place_local_batch)."""
         import jax
 
         indices = np.asarray(host_batch.indices)
         if self.mesh is not None:
+            if self._n_proc > 1:
+                from ape_x_dqn_tpu.parallel.dp import place_local_batch
+
+                return indices, place_local_batch(host_batch, self.mesh)
             from ape_x_dqn_tpu.parallel import place_batch
 
             return indices, place_batch(host_batch, self.mesh)
         return indices, jax.device_put(host_batch)
+
+    def _params_host(self, tree):
+        """Host copy of a replicated pytree (params or the whole train
+        state) under multi-host SPMD — device_get/np.asarray on arrays
+        spanning non-addressable devices raises, so read each leaf's local
+        replica instead.  Single-process: pass through untouched."""
+        if self._n_proc == 1:
+            return tree
+        import jax
+
+        from ape_x_dqn_tpu.parallel.multihost import host_value
+
+        return jax.tree_util.tree_map(
+            lambda x: host_value(x) if hasattr(x, "addressable_data") else x,
+            tree,
+        )
+
+    def _priorities_host(self, priorities) -> np.ndarray:
+        """Host numpy of the step's priorities: under multi-host SPMD only
+        this host's shard (its own replay rows) — np.asarray on an array
+        spanning non-addressable devices raises."""
+        if self._n_proc > 1:
+            from ape_x_dqn_tpu.parallel.multihost import local_shard
+
+            return local_shard(priorities)
+        return np.asarray(priorities)
 
     def _emit(self, metrics=None, final: bool = False) -> dict:
         eps = self.worker.drain_episodes()

@@ -89,23 +89,29 @@ class Components:
         )
         return step_fn, sharded_state, mesh
 
-    def make_sampler(self, learner_step_fn: Callable[[], int]):
+    def make_sampler(
+        self,
+        learner_step_fn: Callable[[], int],
+        sample_size: Optional[int] = None,
+        rng_salt: int = 0,
+    ):
         """Replay sampler with the β-annealed IS schedule; ``learner_step_fn``
-        supplies the current step for annealing."""
+        supplies the current step for annealing.  ``sample_size`` overrides
+        the config batch (multi-host: each process samples its B/n share);
+        ``rng_salt`` decorrelates per-host sampling streams."""
         import numpy as np
 
         from ape_x_dqn_tpu.runtime.single_process import beta_schedule
 
-        rng = np.random.default_rng(self.cfg.seed + 7)
+        rng = np.random.default_rng(self.cfg.seed + 7 + rng_salt)
         cfg = self.cfg
+        size = sample_size or cfg.learner.replay_sample_size
 
         def sample():
             beta = beta_schedule(
                 learner_step_fn(), cfg.learner.total_steps, cfg.replay.is_exponent
             )
-            return self.replay.sample(
-                cfg.learner.replay_sample_size, beta=beta, rng=rng
-            )
+            return self.replay.sample(size, beta=beta, rng=rng)
 
         return sample
 

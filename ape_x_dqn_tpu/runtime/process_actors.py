@@ -188,6 +188,15 @@ class SharedBufferParamSource:
         return restore_like(self._template, payload), version
 
 
+def worker_slice(worker_id: int, num_actors: int, num_workers: int) -> tuple:
+    """[lo, hi) of the global actor set owned by ``worker_id`` — the ONE
+    partition rule, used by both the worker (fleet construction) and the
+    pool (restart-budget accounting)."""
+    lo = worker_id * num_actors // num_workers
+    hi = (worker_id + 1) * num_actors // num_workers
+    return lo, hi
+
+
 def _cfg_from_dict(cfg_dict: dict):
     from ape_x_dqn_tpu.config import (
         ActorConfig, ApexConfig, EnvConfig, LearnerConfig, ReplayConfig,
@@ -236,7 +245,7 @@ def network_and_template(cfg):
 
 def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                  shm_name: str, shm_capacity: int, xp_queue, stop_evt,
-                 steps_budget: int, quantum: int):
+                 steps_budget: int, quantum: int, attempt: int = 0):
     """Worker process entry: CPU-only jax, one ActorFleet slice, pump
     chunks + episode stats into the experience queue."""
     os.environ["JAX_PLATFORMS"] = "cpu"  # before the first jax import
@@ -253,10 +262,8 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
         from ape_x_dqn_tpu.envs import make_env
 
         cfg = _cfg_from_dict(cfg_dict)
-        # Slice [lo, hi) of the global actor set for this worker.
         N = cfg.actor.num_actors
-        lo = worker_id * N // num_workers
-        hi = (worker_id + 1) * N // num_workers
+        lo, hi = worker_slice(worker_id, N, num_workers)
         if hi == lo:
             xp_queue.put(("done", worker_id, 0))
             return
@@ -276,7 +283,9 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
             epsilon_alpha=cfg.actor.alpha,
             flush_every=cfg.actor.flush_every,
             sync_every=cfg.actor.sync_every,
-            seed=cfg.seed + 9000 + worker_id,
+            # Respawned incarnations explore a fresh stream (thread mode's
+            # seed_offset twin).
+            seed=cfg.seed + 9000 + worker_id + 100_000 * attempt,
             epsilon_index_offset=lo,
             epsilon_total=N,
         )
@@ -327,7 +336,8 @@ class ProcessActorPool:
 
     def __init__(self, cfg, num_workers: int = 2,
                  shm_capacity: Optional[int] = None,
-                 queue_size: int = 64, quantum: Optional[int] = None):
+                 queue_size: int = 64, quantum: Optional[int] = None,
+                 max_restarts: int = 3):
         import jax
 
         from ape_x_dqn_tpu.config import to_dict
@@ -355,20 +365,71 @@ class ProcessActorPool:
         self.episodes: List[tuple] = []
         self.last_versions = {}   # worker_id -> param version in latest chunk
         self.finished_workers = set()
-        self.worker_errors = {}
+        self.worker_errors = {}   # FATAL errors (restart budget exhausted)
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self._steps_by_worker: dict = {}      # cumulative, across restarts
+        self._reported_errors: dict = {}      # wid -> last error message
+        self._attempt: dict = {}              # wid -> spawn attempt count
+        self._dead_since: dict = {}           # wid -> first-seen-dead time
+        self._silent_death_grace_s = 10.0
+
+    def _spawn(self, wid: int, budget: int):
+        attempt = self._attempt.get(wid, 0)
+        self._attempt[wid] = attempt + 1
+        p = self._ctx.Process(
+            target=_worker_main,
+            args=(wid, self._cfg_dict, self.num_workers, self.buffer.name,
+                  self.buffer.capacity, self.queue, self.stop_event,
+                  budget, self._quantum, attempt),
+            daemon=True,
+        )
+        p.start()
+        return p
 
     def start(self):
-        per_worker_budget = self.cfg.actor.T
         for w in range(self.num_workers):
-            p = self._ctx.Process(
-                target=_worker_main,
-                args=(w, self._cfg_dict, self.num_workers, self.buffer.name,
-                      self.buffer.capacity, self.queue, self.stop_event,
-                      per_worker_budget, self._quantum),
-                daemon=True,
+            self._procs.append(self._spawn(w, self.cfg.actor.T))
+
+    def supervise(self) -> None:
+        """Respawn dead workers (SURVEY §5 failure detection: actors are
+        stateless modulo ε/seed, so recovery is respawn + param re-pull —
+        the process-mode twin of _ActorWorker._supervise).  A worker that
+        exited without a clean "done" — a reported exception OR a silent
+        death (crash, OOM-kill) — restarts with its REMAINING step budget;
+        after ``max_restarts`` total restarts, the next death is fatal
+        (recorded in worker_errors, which stops the pipeline)."""
+        if self.stop_event.is_set():
+            return
+        for wid, p in enumerate(self._procs):
+            if p.is_alive() or wid in self.finished_workers \
+                    or wid in self.worker_errors:
+                continue
+            # A zero-exit death is normally a clean "done" (or a reported
+            # error) whose message is still queued — poll() will classify
+            # it.  Only a grace-period timeout turns an unexplained
+            # zero-exit into a silent death (e.g. the final queue put
+            # itself failed), so a clean finisher is never spuriously
+            # respawned nor recorded as a fatal error.
+            if p.exitcode == 0 and wid not in self._reported_errors:
+                first = self._dead_since.setdefault(wid, time.monotonic())
+                if time.monotonic() - first < self._silent_death_grace_s:
+                    continue
+            self._dead_since.pop(wid, None)
+            err = self._reported_errors.pop(
+                wid, f"worker exited silently (exitcode {p.exitcode})"
             )
-            p.start()
-            self._procs.append(p)
+            if self.restarts >= self.max_restarts:
+                self.worker_errors[wid] = err
+                continue
+            self.restarts += 1
+            budget = max(
+                0, self.cfg.actor.T - self._steps_by_worker.get(wid, 0)
+            )
+            if budget == 0:
+                self.finished_workers.add(wid)
+                continue
+            self._procs[wid] = self._spawn(wid, budget)
 
     def publish(self, params) -> int:
         return self.store.publish(params)
@@ -397,14 +458,27 @@ class ProcessActorPool:
                 _, wid, version, prio, tdict, steps = msg
                 self.last_versions[wid] = version
                 self.actor_steps += steps
+                # Fleet steps = chunk rows / actors-in-worker; tracked so a
+                # respawn only gets the worker's REMAINING actor.T budget.
+                n_w = self._worker_width(wid)
+                self._steps_by_worker[wid] = (
+                    self._steps_by_worker.get(wid, 0) + steps // max(n_w, 1)
+                )
                 out.append((prio, self._NStepTransition(**tdict)))
             elif kind == "episodes":
                 self.episodes.extend(msg[2])
             elif kind == "done":
                 self.finished_workers.add(msg[1])
             elif kind == "error":
-                self.worker_errors[msg[1]] = msg[2]
+                # Recorded for supervise(): respawnable until the restart
+                # budget runs out, fatal after.
+                self._reported_errors[msg[1]] = msg[2]
         return out
+
+    def _worker_width(self, wid: int) -> int:
+        """Actors in worker ``wid``'s slice of the global set."""
+        lo, hi = worker_slice(wid, self.cfg.actor.num_actors, self.num_workers)
+        return hi - lo
 
     def stop(self, join_timeout: float = 15.0):
         self.stop_event.set()
@@ -449,8 +523,6 @@ class ProcessActorWorker:
         self._external_stop = stop_event
         self.error: Optional[BaseException] = None
         self.heartbeat = time.monotonic()
-        self.restarts = 0   # process workers are not respawned (yet): a
-        # worker crash surfaces via worker_errors → self.error instead.
         self._ep_lock = threading.Lock()
         self.episodes: List = []
         self._thread = threading.Thread(
@@ -464,6 +536,11 @@ class ProcessActorWorker:
     @property
     def actor_steps(self) -> int:
         return self.pool.actor_steps
+
+    @property
+    def restarts(self) -> int:
+        """Worker process respawns (the pool's supervisor counter)."""
+        return self.pool.restarts
 
     def start(self):
         self.pool.start()
@@ -481,6 +558,7 @@ class ProcessActorWorker:
 
     def _pump(self):
         while not self._stop.is_set():
+            self.pool.supervise()
             items = self.pool.poll(max_items=64, timeout=0.05)
             for prio, trans in items:
                 self._sink(prio, trans)

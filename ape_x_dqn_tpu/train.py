@@ -57,11 +57,32 @@ def build_argparser() -> argparse.ArgumentParser:
         help="start the live jax.profiler server on this port "
         "(attach with TensorBoard's profile tab)",
     )
+    p.add_argument(
+        "--coordinator", default=None, metavar="HOST:PORT",
+        help="multi-host SPMD: jax.distributed coordinator address; run the "
+        "SAME command on every host with its own --process-id "
+        "(parallel/multihost.py)",
+    )
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     return p
 
 
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
+    if args.coordinator:
+        # Must run before anything touches the jax backend: after this,
+        # jax.devices() is the GLOBAL device set across all participating
+        # hosts and learner.data_parallel spans it.
+        if args.num_processes is None or args.process_id is None:
+            raise SystemExit(
+                "--coordinator requires --num-processes and --process-id"
+            )
+        from ape_x_dqn_tpu.parallel.multihost import initialize_multihost
+
+        initialize_multihost(
+            args.coordinator, args.num_processes, args.process_id
+        )
     cfg = load_config(args.params_file, overrides=args.overrides)
     print("config:", to_dict(cfg), file=sys.stderr)
     logger = MetricLogger(stream=sys.stdout, path=args.metrics_file)

@@ -8,7 +8,7 @@ sweep tooling at all (its one config file names one game — reference
 parameters.json:5, SURVEY §2 component 9).
 
 Usage:
-    python tools/sweep.py --base configs/sweep_atari57_base.json \
+    python tools/sweep.py --base configs/config5_sweep_atari57_base.json \
         --games atari57 --out sweep_results.jsonl
     python tools/sweep.py --games chain:6,catch --steps 200 --mode sync
 
