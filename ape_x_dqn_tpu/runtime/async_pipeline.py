@@ -168,8 +168,13 @@ class AsyncPipeline:
         self._fused_inflight = max(1, int(fused_inflight))
         self.fused = None
         self.mesh = None
-        self._n_proc = 1       # SPMD process count (multi-host)
-        self._proc_idx = 0
+        # SPMD process identity (multi-host; 1/0 when jax.distributed was
+        # never initialized) — set unconditionally so every publish /
+        # checkpoint / seed path below is host-aware in every mode.
+        import jax
+
+        self._n_proc = jax.process_count()
+        self._proc_idx = jax.process_index()
         sink = None
         if self.cfg.learner.device_replay:
             self.fused = self.comps.make_fused_learner()
@@ -194,14 +199,10 @@ class AsyncPipeline:
             # devices (parallel.place_local_batch — no cross-host batch
             # traffic), the all-reduce crosses DCN inside the step, and
             # each host restamps only its own priority rows.
-            import jax
-
             self.train_step, sharded_state, self.mesh = (
                 self.comps.make_sharded_train_step()
             )
             self.comps.state = sharded_state
-            self._n_proc = jax.process_count()
-            self._proc_idx = jax.process_index()
             if self.cfg.learner.replay_sample_size % self._n_proc:
                 raise ValueError(
                     "learner.replay_sample_size must divide by "
@@ -220,10 +221,13 @@ class AsyncPipeline:
             )
 
             pool = ProcessActorPool(
-                self.cfg, num_workers=self.cfg.actor.num_workers
+                self.cfg, num_workers=self.cfg.actor.num_workers,
+                seed_base=self._proc_idx * 7919,  # per-host exploration
             )
             self.store = pool.store
-            self.store.publish(self.comps.state.params)
+            # _params_host: under multi-host the state may already be
+            # placed over the global mesh — publish the local replica.
+            self.store.publish(self._params_host(self.comps.state.params))
             self.worker = ProcessActorWorker(
                 pool,
                 sink if sink is not None else (
@@ -329,15 +333,32 @@ class AsyncPipeline:
                     if (
                         cfg.learner.checkpoint_every
                         and self._learner_step % cfg.learner.checkpoint_every == 0
-                        and self._proc_idx == 0  # one writer per checkpoint
                     ):
-                        from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
-
-                        save_checkpoint(
-                            cfg.learner.checkpoint_dir,
-                            self._params_host(state),
-                            replay=self.comps.replay,
+                        # Multi-host: one state writer (replicated params —
+                        # process 0), but EVERY host saves its own replay
+                        # shard; restore reads back per host (components).
+                        from ape_x_dqn_tpu.utils.checkpoint import (
+                            save_checkpoint,
+                            save_replay_snapshot,
                         )
+
+                        sfx = (
+                            f"_h{self._proc_idx}" if self._n_proc > 1 else ""
+                        )
+                        if self._proc_idx == 0:
+                            save_checkpoint(
+                                cfg.learner.checkpoint_dir,
+                                self._params_host(state),
+                                replay=self.comps.replay,
+                                replay_suffix=sfx,
+                            )
+                        else:
+                            save_replay_snapshot(
+                                cfg.learner.checkpoint_dir,
+                                self._learner_step,
+                                self.comps.replay,
+                                replay_suffix=sfx,
+                            )
                     if self._learner_step % self.log_every == 0:
                         self._emit(metrics)
                 if pending is not None:

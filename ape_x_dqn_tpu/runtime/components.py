@@ -228,9 +228,15 @@ def build_components(cfg: ApexConfig) -> Components:
             if cfg.learner.restore_from is True
             else str(cfg.learner.restore_from)
         )
+        # Multi-host SPMD: every host restores the (replicated) train state
+        # from the shared dir but ONLY its own replay shard — host i saved
+        # replay_h<i>.npz (async_pipeline checkpoint sites).
+        suffix = (
+            f"_h{jax.process_index()}" if jax.process_count() > 1 else ""
+        )
         try:
             state, learner_step = restore_checkpoint(
-                restore_path, state, replay=replay
+                restore_path, state, replay=replay, replay_suffix=suffix
             )
             restored_path = restore_path
             print(f"restored checkpoint at step {learner_step}")

@@ -245,7 +245,8 @@ def network_and_template(cfg):
 
 def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                  shm_name: str, shm_capacity: int, xp_queue, stop_evt,
-                 steps_budget: int, quantum: int, attempt: int = 0):
+                 steps_budget: int, quantum: int, attempt: int = 0,
+                 seed_base: int = 0):
     """Worker process entry: CPU-only jax, one ActorFleet slice, pump
     chunks + episode stats into the experience queue."""
     os.environ["JAX_PLATFORMS"] = "cpu"  # before the first jax import
@@ -284,8 +285,8 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
             flush_every=cfg.actor.flush_every,
             sync_every=cfg.actor.sync_every,
             # Respawned incarnations explore a fresh stream (thread mode's
-            # seed_offset twin).
-            seed=cfg.seed + 9000 + worker_id + 100_000 * attempt,
+            # seed_offset twin); seed_base separates hosts under SPMD.
+            seed=cfg.seed + 9000 + worker_id + 100_000 * attempt + seed_base,
             epsilon_index_offset=lo,
             epsilon_total=N,
         )
@@ -337,7 +338,7 @@ class ProcessActorPool:
     def __init__(self, cfg, num_workers: int = 2,
                  shm_capacity: Optional[int] = None,
                  queue_size: int = 64, quantum: Optional[int] = None,
-                 max_restarts: int = 3):
+                 max_restarts: int = 3, seed_base: int = 0):
         import jax
 
         from ape_x_dqn_tpu.config import to_dict
@@ -373,6 +374,9 @@ class ProcessActorPool:
         self._attempt: dict = {}              # wid -> spawn attempt count
         self._dead_since: dict = {}           # wid -> first-seen-dead time
         self._silent_death_grace_s = 10.0
+        # Per-host exploration component (multi-host SPMD: each host's
+        # workers must not duplicate another host's streams).
+        self._seed_base = int(seed_base)
 
     def _spawn(self, wid: int, budget: int):
         attempt = self._attempt.get(wid, 0)
@@ -381,7 +385,7 @@ class ProcessActorPool:
             target=_worker_main,
             args=(wid, self._cfg_dict, self.num_workers, self.buffer.name,
                   self.buffer.capacity, self.queue, self.stop_event,
-                  budget, self._quantum, attempt),
+                  budget, self._quantum, attempt, self._seed_base),
             daemon=True,
         )
         p.start()
@@ -419,16 +423,18 @@ class ProcessActorPool:
             err = self._reported_errors.pop(
                 wid, f"worker exited silently (exitcode {p.exitcode})"
             )
-            if self.restarts >= self.max_restarts:
-                self.worker_errors[wid] = err
-                continue
-            self.restarts += 1
             budget = max(
                 0, self.cfg.actor.T - self._steps_by_worker.get(wid, 0)
             )
             if budget == 0:
+                # Budget exhausted = a clean finish whatever the exit shape
+                # — no respawn needed, so no restart credit is consumed.
                 self.finished_workers.add(wid)
                 continue
+            if self.restarts >= self.max_restarts:
+                self.worker_errors[wid] = err
+                continue
+            self.restarts += 1
             self._procs[wid] = self._spawn(wid, budget)
 
     def publish(self, params) -> int:

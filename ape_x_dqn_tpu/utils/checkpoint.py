@@ -52,10 +52,14 @@ def save_checkpoint(
     state: TrainState,
     replay=None,
     keep: int = 3,
+    replay_suffix: str = "",
 ) -> str:
     """Save the train state (and optionally the replay) at its step count.
 
     Retains the newest ``keep`` checkpoints, pruning older ones.
+    ``replay_suffix`` names per-host replay shards under multi-host SPMD
+    (each host saves its OWN buffer as ``replay_h<i>.npz`` — see
+    :func:`save_replay_snapshot` for the non-zero hosts' entry point).
     """
     step = int(jax.device_get(state.step))
     path = _step_dir(root, step)
@@ -67,10 +71,27 @@ def save_checkpoint(
             force=True,
         )
     if replay is not None:
-        np.savez(os.path.join(path, "replay.npz"), **replay.state_dict())
+        np.savez(
+            os.path.join(path, f"replay{replay_suffix}.npz"),
+            **replay.state_dict(),
+        )
     if keep is not None:
         _prune(root, keep)
     return path
+
+
+def save_replay_snapshot(root: str, step: int, replay,
+                         replay_suffix: str = "") -> str:
+    """Replay-only save for multi-host non-zero hosts: process 0 writes
+    the train state (replicated — one copy suffices) while EVERY host
+    writes its own replay shard into the same step dir.  A step dir only
+    counts as committed once process 0's state lands (latest_step), so an
+    orphaned shard from a crashed round is never restored."""
+    path = _step_dir(root, step)
+    os.makedirs(path, exist_ok=True)
+    file = os.path.join(path, f"replay{replay_suffix}.npz")
+    np.savez(file, **replay.state_dict())
+    return file
 
 
 def _resolve_step_path(root_or_path: str) -> str:
@@ -89,6 +110,7 @@ def restore_checkpoint(
     root_or_path: str,
     state_template: TrainState,
     replay=None,
+    replay_suffix: str = "",
 ) -> Tuple[TrainState, int]:
     """Restore the newest (or an explicit ``step_N``) checkpoint.
 
@@ -115,17 +137,21 @@ def restore_checkpoint(
         state,
     )
     if replay is not None:
-        load_replay_snapshot(path, replay)
+        load_replay_snapshot(path, replay, replay_suffix=replay_suffix)
     return state, int(jax.device_get(state.step))
 
 
-def load_replay_snapshot(root_or_path: str, replay) -> bool:
+def load_replay_snapshot(root_or_path: str, replay,
+                         replay_suffix: str = "") -> bool:
     """Load the newest checkpoint's replay snapshot into ``replay`` (any
     object with ``load_state_dict``).  Returns False when the checkpoint has
     no replay leg — runtimes that construct their replay after the train
     state was restored (the fused device learner) use this for the second
-    half of resume."""
-    replay_file = os.path.join(_resolve_step_path(root_or_path), "replay.npz")
+    half of resume.  ``replay_suffix`` selects this host's shard under
+    multi-host SPMD."""
+    replay_file = os.path.join(
+        _resolve_step_path(root_or_path), f"replay{replay_suffix}.npz"
+    )
     if not os.path.exists(replay_file):
         return False
     with np.load(replay_file) as z:

@@ -250,3 +250,48 @@ def test_load_replay_snapshot_absent_returns_false(tmp_path):
             raise AssertionError("must not be called")
 
     assert load_replay_snapshot(str(tmp_path), Sink()) is False
+
+
+def test_per_host_replay_shards_roundtrip(tmp_path):
+    """Multi-host checkpoint layout: process 0 saves state + its shard,
+    other hosts save replay-only shards into the same step dir; each host
+    restores ITS OWN shard (nothing lost, nothing duplicated)."""
+    from ape_x_dqn_tpu.utils.checkpoint import (
+        load_replay_snapshot,
+        save_replay_snapshot,
+    )
+
+    net = DuelingMLP(num_actions=3, hidden_sizes=(16,))
+    opt = make_optimizer("adam")
+    state = init_train_state(net, opt, jax.random.PRNGKey(0),
+                             jnp.zeros((1, 8), jnp.uint8))
+
+    def filled_replay(fill_value):
+        rep = PrioritizedReplay(64, (8,))
+        n = 16
+        rep.add(
+            np.full(n, 1.0),
+            NStepTransition(
+                obs=np.full((n, 8), fill_value, np.uint8),
+                action=np.zeros(n, np.int32),
+                reward=np.ones(n, np.float32),
+                discount=np.full(n, 0.9, np.float32),
+                next_obs=np.full((n, 8), fill_value, np.uint8),
+            ),
+        )
+        return rep
+
+    r0, r1 = filled_replay(11), filled_replay(22)
+    # Host 0 writes state + its shard; host 1 its shard only.
+    save_checkpoint(str(tmp_path), state, replay=r0, replay_suffix="_h0")
+    save_replay_snapshot(str(tmp_path), int(state.step), r1,
+                         replay_suffix="_h1")
+    # Each host restores its own shard.
+    back0, back1 = PrioritizedReplay(64, (8,)), PrioritizedReplay(64, (8,))
+    assert load_replay_snapshot(str(tmp_path), back0, replay_suffix="_h0")
+    assert load_replay_snapshot(str(tmp_path), back1, replay_suffix="_h1")
+    assert back0._obs.get(np.arange(1))[0, 0] == 11
+    assert back1._obs.get(np.arange(1))[0, 0] == 22
+    # The wrong suffix is absent, not silently cross-loaded.
+    assert not load_replay_snapshot(str(tmp_path), PrioritizedReplay(64, (8,)),
+                                    replay_suffix="_h9")

@@ -224,12 +224,17 @@ class TestElasticRecovery:
             pool.start()
             deadline = time.monotonic() + 240
             kills = 0
+            last_seen = -1  # only kill AFTER new progress since the last
+            # kill, so each incarnation demonstrably ran (not killed during
+            # its jax-import startup window)
             while time.monotonic() < deadline and not pool.worker_errors:
                 pool.supervise()
                 pool.poll(max_items=64, timeout=0.1)
                 p = pool._procs[0]
-                if p.is_alive() and pool._steps_by_worker.get(0, 0) >= 0 \
+                steps = pool._steps_by_worker.get(0, 0)
+                if p.is_alive() and steps > last_seen \
                         and 0 in pool.last_versions:
+                    last_seen = steps
                     os.kill(p.pid, signal.SIGKILL)
                     p.join(10.0)
                     kills += 1
