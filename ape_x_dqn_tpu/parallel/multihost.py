@@ -82,6 +82,19 @@ def local_shard(arr) -> np.ndarray:
     return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
 
 
+def barrier(name: str) -> None:
+    """Cross-host synchronization point (no-op single-process).  Used to
+    order multi-host checkpoint writes: every host's replay shard must be
+    on disk BEFORE process 0 commits the state dir that marks the
+    checkpoint as restorable."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def process_count() -> int:
     import jax
 

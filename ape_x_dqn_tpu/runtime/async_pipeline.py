@@ -334,29 +334,38 @@ class AsyncPipeline:
                         cfg.learner.checkpoint_every
                         and self._learner_step % cfg.learner.checkpoint_every == 0
                     ):
-                        # Multi-host: one state writer (replicated params —
-                        # process 0), but EVERY host saves its own replay
-                        # shard; restore reads back per host (components).
+                        # Multi-host: EVERY host saves its own replay shard
+                        # FIRST, a barrier proves all shards are on disk,
+                        # and only then does process 0 write state/ — the
+                        # marker that makes the step dir restorable — so a
+                        # restore can never see a committed checkpoint with
+                        # missing shards.  The shard step comes from the
+                        # same state the state-writer uses, keeping both
+                        # sides of the dir name on one source of truth.
                         from ape_x_dqn_tpu.utils.checkpoint import (
+                            replay_shard_suffix,
                             save_checkpoint,
                             save_replay_snapshot,
                         )
 
-                        sfx = (
-                            f"_h{self._proc_idx}" if self._n_proc > 1 else ""
-                        )
+                        sfx = replay_shard_suffix()
+                        host_state = self._params_host(state)
+                        if self._n_proc > 1:
+                            from ape_x_dqn_tpu.parallel.multihost import barrier
+
+                            if self._proc_idx != 0:
+                                save_replay_snapshot(
+                                    cfg.learner.checkpoint_dir,
+                                    int(np.asarray(host_state.step)),
+                                    self.comps.replay,
+                                    replay_suffix=sfx,
+                                )
+                            barrier("replay-shards-before-state-commit")
                         if self._proc_idx == 0:
                             save_checkpoint(
                                 cfg.learner.checkpoint_dir,
-                                self._params_host(state),
+                                host_state,
                                 replay=self.comps.replay,
-                                replay_suffix=sfx,
-                            )
-                        else:
-                            save_replay_snapshot(
-                                cfg.learner.checkpoint_dir,
-                                self._learner_step,
-                                self.comps.replay,
                                 replay_suffix=sfx,
                             )
                     if self._learner_step % self.log_every == 0:

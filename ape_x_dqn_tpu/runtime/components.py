@@ -231,9 +231,9 @@ def build_components(cfg: ApexConfig) -> Components:
         # Multi-host SPMD: every host restores the (replicated) train state
         # from the shared dir but ONLY its own replay shard — host i saved
         # replay_h<i>.npz (async_pipeline checkpoint sites).
-        suffix = (
-            f"_h{jax.process_index()}" if jax.process_count() > 1 else ""
-        )
+        from ape_x_dqn_tpu.utils.checkpoint import replay_shard_suffix
+
+        suffix = replay_shard_suffix()
         try:
             state, learner_step = restore_checkpoint(
                 restore_path, state, replay=replay, replay_suffix=suffix

@@ -45,6 +45,10 @@ def build_argparser() -> argparse.ArgumentParser:
         "--steps", type=int, default=None, help="learner steps (default: config)"
     )
     p.add_argument("--metrics-file", default=None, help="also write JSONL here")
+    p.add_argument(
+        "--tensorboard-dir", default=None,
+        help="also write scalar metrics as TensorBoard events here",
+    )
     p.add_argument("--log-every", type=int, default=500)
     p.add_argument(
         "--profile-dir", default=None,
@@ -85,7 +89,11 @@ def main(argv=None) -> int:
         )
     cfg = load_config(args.params_file, overrides=args.overrides)
     print("config:", to_dict(cfg), file=sys.stderr)
-    logger = MetricLogger(stream=sys.stdout, path=args.metrics_file)
+    logger = MetricLogger(
+        stream=sys.stdout,
+        path=args.metrics_file,
+        tensorboard_dir=args.tensorboard_dir,
+    )
     import contextlib
 
     from ape_x_dqn_tpu.utils.profiling import start_server, trace

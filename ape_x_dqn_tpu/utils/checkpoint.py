@@ -31,6 +31,15 @@ from ape_x_dqn_tpu.types import TrainState
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
 
+def replay_shard_suffix() -> str:
+    """This host's replay-shard filename suffix — the ONE spelling shared
+    by save (runtime) and restore (components): ``replay_h<i>.npz`` under
+    multi-host SPMD, plain ``replay.npz`` single-process."""
+    import jax
+
+    return f"_h{jax.process_index()}" if jax.process_count() > 1 else ""
+
+
 def _step_dir(root: str, step: int) -> str:
     return os.path.join(os.path.abspath(root), f"step_{step}")
 
@@ -136,8 +145,15 @@ def restore_checkpoint(
         state_template,
         state,
     )
-    if replay is not None:
-        load_replay_snapshot(path, replay, replay_suffix=replay_suffix)
+    if replay is not None and not load_replay_snapshot(
+        path, replay, replay_suffix=replay_suffix
+    ):
+        # Loud, not silent: resuming without the buffer is a degraded
+        # restart (the learner retrains on an empty replay).
+        print(
+            f"WARNING: checkpoint {path} has no replay snapshot "
+            f"(replay{replay_suffix}.npz) — resuming with an empty buffer"
+        )
     return state, int(jax.device_get(state.step))
 
 

@@ -67,7 +67,8 @@ class MetricLogger:
     logger.
     """
 
-    def __init__(self, stream: Optional[IO] = None, path: Optional[str] = None):
+    def __init__(self, stream: Optional[IO] = None, path: Optional[str] = None,
+                 tensorboard_dir: Optional[str] = None):
         self._streams: list[IO] = []
         if stream is not None:
             self._streams.append(stream)
@@ -79,6 +80,18 @@ class MetricLogger:
         self._acc: Dict[str, list] = defaultdict(list)
         self._lock = threading.Lock()
         self._start = time.monotonic()
+        # Optional TensorBoard sink (SURVEY §5 metrics subsystem): scalar
+        # means per emit, stepped by the emit's ``step`` field.  Gated
+        # import — absent torch degrades to a warning, never a crash.
+        self._tb = None
+        if tensorboard_dir:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=tensorboard_dir)
+            except Exception as e:  # noqa: BLE001 — optional dependency
+                print(f"WARNING: TensorBoard sink unavailable ({e})",
+                      file=sys.stderr)
 
     def log(self, name: str, value: float) -> None:
         with self._lock:
@@ -106,8 +119,15 @@ class MetricLogger:
                 s.flush()
             except ValueError:  # closed stream
                 pass
+        if self._tb is not None:
+            step = int(record.get("step", 0))
+            for k, v in record.items():
+                if isinstance(v, (int, float)) and k not in ("step", "final"):
+                    self._tb.add_scalar(k, v, global_step=step)
         return record
 
     def close(self) -> None:
         if self._file:
             self._file.close()
+        if self._tb is not None:
+            self._tb.close()

@@ -294,6 +294,12 @@ def main() -> None:
         "fused learner contending on the chip; ~90s)",
     )
     parser.add_argument("--pipeline-steps", type=int, default=20_000)
+    parser.add_argument(
+        "--host-replay-capacity", type=int, default=2_000_000,
+        help="slots for the host sum-tree replay bench; NB the raw frame "
+        "stores preallocate ~14 MB per 1000 slots (28 GB at the 2M "
+        "default) — shrink on small-RAM machines",
+    )
     args = parser.parse_args()
 
     import jax
@@ -391,7 +397,9 @@ def main() -> None:
     }
     if not args.skip_sampler_validation:
         extra["samplers_2m"] = _validate_samplers(rng)
-        extra["host_replay_2m"] = _host_replay_bench()
+        extra["host_replay_2m"] = _host_replay_bench(
+            capacity=args.host_replay_capacity
+        )
     if not args.skip_pipeline:
         extra["actor_solo"] = _actor_solo_bench()
         extra["pipeline"] = _pipeline_bench(args.pipeline_steps)

@@ -209,3 +209,20 @@ class TestAsyncPipeline:
         pipe.comps.env_fns = [lambda: AlwaysCrash(6)] * cfg.actor.num_actors
         with pytest.raises(RuntimeError):
             pipe.run(learner_steps=50, warmup_timeout=5.0)
+
+
+def test_metric_logger_tensorboard_sink(tmp_path):
+    """Optional TensorBoard sink (SURVEY §5): scalar events land in the
+    log dir; absence of torch degrades to a warning (gated import)."""
+    import os
+
+    pytest.importorskip("torch")
+
+    logger = MetricLogger(stream=io.StringIO(),
+                          tensorboard_dir=str(tmp_path / "tb"))
+    logger.log("learner/loss", 0.5)
+    logger.log("learner/loss", 0.7)
+    logger.emit(step=10, steps_per_sec=123.0)
+    logger.close()
+    files = os.listdir(tmp_path / "tb")
+    assert any(f.startswith("events.out.tfevents") for f in files), files
