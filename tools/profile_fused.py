@@ -245,8 +245,17 @@ def main() -> None:
             {k: round(v, 4) for k, v in seconds.items()}) + "`",
         "",
     ]
+    # Preserve hand-written analysis sections (everything from the first
+    # "## " heading on): this tool owns only the generated ablation block
+    # above them — a rerun must not wipe the round-notes appendices.
+    preserved = ""
+    if os.path.exists(args.out):
+        old = open(args.out).read()
+        i = old.find("\n## ")
+        if i != -1:
+            preserved = old[i:]
     with open(args.out, "w") as f:
-        f.write("\n".join(lines))
+        f.write("\n".join(lines) + preserved)
     print(json.dumps({"us_per_step": {k: round(v, 1) for k, v in us.items()},
                       "deltas": {k: round(v, 1) for k, v in deltas.items()}}))
 
