@@ -250,10 +250,14 @@ def main() -> None:
     # above them — a rerun must not wipe the round-notes appendices.
     preserved = ""
     if os.path.exists(args.out):
+        import re
+
         old = open(args.out).read()
-        i = old.find("\n## ")
-        if i != -1:
-            preserved = old[i:]
+        # Any heading level counts as "hand-written starts here" — the
+        # generated block's own "# PROFILE" title is line 1, so skip it.
+        m = re.search(r"\n#{1,6} ", old)
+        if m:
+            preserved = old[m.start():]
     with open(args.out, "w") as f:
         f.write("\n".join(lines) + preserved)
     print(json.dumps({"us_per_step": {k: round(v, 1) for k, v in us.items()},
