@@ -73,6 +73,7 @@ class _ActorWorker:
         # cross-process equality.
         self._seed_base = seed_base
         self.finished = False  # clean exit (actor.T reached), not a crash
+        self.fleet_steps = 0   # total fleet steps across incarnations
         self.heartbeat = time.monotonic()
         self.episodes: List[EpisodeStat] = []
         self._ep_lock = threading.Lock()
@@ -107,12 +108,14 @@ class _ActorWorker:
                 )
                 fleet.sync_params(self._store)
                 self._run_fleet(fleet, self._comps.cfg.actor.T - steps_done)
+                self.fleet_steps = steps_done + fleet.step_count
                 # Distinguish "actor.T exhausted" from "told to stop".
                 self.finished = not self._stop.is_set()
                 return  # clean stop
             except Exception as e:
                 if fleet is not None:
                     steps_done += fleet.step_count
+                    self.fleet_steps = steps_done
                 self.restarts += 1
                 self._logger.log("actor/restarts", self.restarts)
                 if self.restarts > self._max_restarts:
@@ -123,7 +126,11 @@ class _ActorWorker:
 
     def _run_fleet(self, fleet, max_steps: int):
         while not self._stop.is_set() and fleet.step_count < max_steps:
-            chunks, stats = fleet.collect(self._quantum, param_source=self._store)
+            # Clamp the final quantum so the fleet lands on max_steps
+            # exactly — actor.T bounds TOTAL env steps, and an unclamped
+            # collect could overshoot by quantum-1 steps per incarnation.
+            quantum = min(self._quantum, max_steps - fleet.step_count)
+            chunks, stats = fleet.collect(quantum, param_source=self._store)
             for chunk in chunks:
                 self._sink(chunk.priorities, chunk.transitions)
                 self.actor_steps += chunk.actor_steps
@@ -175,6 +182,30 @@ class AsyncPipeline:
 
         self._n_proc = jax.process_count()
         self._proc_idx = jax.process_index()
+        if self._n_proc > 1:
+            # Multi-host SPMD sanity (round-3 advisor): with data_parallel=1
+            # each host would silently train an independent, divergent model
+            # on a B/n batch; and the fused HBM path has no multi-host story
+            # (per-host rings + concurrent same-dir checkpoint saves) —
+            # reject both shapes at init instead of corrupting a run.
+            if self.cfg.learner.device_replay:
+                raise ValueError(
+                    "learner.device_replay=True is single-process only — "
+                    "multi-host SPMD runs use the host-replay path with "
+                    "learner.data_parallel spanning all hosts' devices"
+                )
+            if self.cfg.learner.data_parallel <= 1:
+                raise ValueError(
+                    f"jax.process_count()={self._n_proc} requires "
+                    "learner.data_parallel > 1: the mesh must span every "
+                    "host's devices, or each host trains an independent "
+                    "model on a fractional batch"
+                )
+            if self.cfg.learner.replay_sample_size % self._n_proc:
+                raise ValueError(
+                    "learner.replay_sample_size must divide by "
+                    f"jax.process_count()={self._n_proc}"
+                )
         sink = None
         if self.cfg.learner.device_replay:
             self.fused = self.comps.make_fused_learner()
@@ -203,11 +234,6 @@ class AsyncPipeline:
                 self.comps.make_sharded_train_step()
             )
             self.comps.state = sharded_state
-            if self.cfg.learner.replay_sample_size % self._n_proc:
-                raise ValueError(
-                    "learner.replay_sample_size must divide by "
-                    f"jax.process_count()={self._n_proc}"
-                )
         else:
             self.train_step = self.comps.make_train_step()
         if self.cfg.actor.mode == "process":
@@ -439,11 +465,7 @@ class AsyncPipeline:
                     with self.timers.stage("publish"):
                         self.store.publish(fused.params_for_publish())
                 if next_ckpt is not None and self._learner_step >= next_ckpt:
-                    from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
-
-                    save_checkpoint(
-                        cfg.learner.checkpoint_dir, fused.state, replay=fused,
-                    )
+                    self._save_fused_checkpoint()
                     next_ckpt += cfg.learner.checkpoint_every
                 if self._learner_step >= next_log:
                     self._emit_fused(last_metrics)
@@ -458,6 +480,19 @@ class AsyncPipeline:
             if not np.all(np.isfinite(loss)):
                 raise FloatingPointError("non-finite loss in fused learner")
         return self._emit_fused(last_metrics, final=True)
+
+    def _save_fused_checkpoint(self) -> str:
+        """Periodic fused-mode save.  The HBM snapshot (state_dict) excludes
+        staged-but-uningested host rows — drain them into the ring first so
+        a crash-restore from THIS checkpoint loses nothing (rows actors
+        stage mid-save remain the only, bounded, gap)."""
+        from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
+
+        self.fused.ingest_staged(drain=True)
+        return save_checkpoint(
+            self.cfg.learner.checkpoint_dir, self.fused.state,
+            replay=self.fused,
+        )
 
     def _emit_fused(self, metrics, final: bool = False) -> dict:
         import numpy as np

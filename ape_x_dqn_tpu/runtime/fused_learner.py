@@ -135,10 +135,12 @@ class FusedDeviceLearner:
     def ingest_staged(self, drain: bool = False) -> int:
         """Move staged host rows to HBM in fixed ``ingest_block`` blocks.
 
-        Learner-thread only.  Returns rows ingested.  ``drain=True`` pads
-        the final partial block by repeating its last row with zero-ish
-        priority weight — only for shutdown/checkpoint flushes; steady
-        state keeps blocks exact.
+        Learner-thread only.  Returns rows ingested.  ``drain=True`` also
+        ingests the final partial block, decomposed into power-of-2
+        sub-blocks — static shapes (at most log2(ingest_block) compiled
+        variants, cached by jit) with no padding, so drains at checkpoint
+        cadence never leak junk slots into the ring; steady state keeps
+        blocks exact.
         """
         with self._lock:
             staged, self._staged = self._staged, []
@@ -162,18 +164,20 @@ class FusedDeviceLearner:
         rem = len(prio) - n_full * m
         if rem:
             if drain:
-                pad = m - rem
-                tail = jax.tree_util.tree_map(
-                    lambda a: jnp.asarray(
-                        np.concatenate([a[n_full * m:], np.repeat(a[-1:], pad, 0)])
-                    ),
-                    cat,
-                )
-                tail_p = np.concatenate(
-                    [prio[n_full * m:], np.full((pad,), 1e-9, np.float32)]
-                )
-                self._replay = self._add(self._replay, tail, jnp.asarray(tail_p))
-                ingested += rem  # padding rows carry ~zero sampling mass
+                off = n_full * m
+                while rem:
+                    sub = 1 << (rem.bit_length() - 1)  # largest 2^k <= rem
+                    sl = slice(off, off + sub)
+                    self._replay = self._add(
+                        self._replay,
+                        jax.tree_util.tree_map(
+                            lambda a: jnp.asarray(a[sl]), cat
+                        ),
+                        jnp.asarray(prio[sl]),
+                    )
+                    off += sub
+                    rem -= sub
+                    ingested += sub
             else:
                 with self._lock:  # push the partial tail back for next time
                     self._staged.insert(

@@ -42,16 +42,26 @@ from typing import Any, List, Optional
 
 import numpy as np
 
-_HEADER = struct.Struct("<qq")  # (seqlock version, payload length)
+_HEADER = struct.Struct("<qqI")  # (seqlock version, payload length, crc32)
 
 
 class SharedParamBuffer:
     """Single-writer seqlock over one shared-memory snapshot slot.
 
-    Write protocol: bump version to odd, copy payload, bump to even.
-    Read protocol: spin until an even version reads identically before and
-    after the payload copy.  The single writer (the learner) never blocks;
-    readers retry only during the microseconds a write is in flight.
+    Write protocol: bump version to odd, copy payload, commit crc32 +
+    even version.  Read protocol: spin until an even version reads
+    identically before and after the payload copy AND the copied payload's
+    crc32 matches the committed header.  The single writer (the learner)
+    never blocks; readers retry only during the microseconds a write is in
+    flight.
+
+    Memory-ordering note: the version-recheck alone is only sound on
+    TSO-ordered CPUs (x86) — Python buffer stores carry no fences, so a
+    weakly-ordered host (ARM) could make payload stores visible *after* the
+    even-version store and admit a torn read.  The crc32 closes that hole:
+    a reader accepts a payload only if its checksum matches the committed
+    header, so any interleaving that mixes bytes of two snapshots is
+    detected and retried regardless of store visibility order.
     """
 
     def __init__(self, capacity: int, name: Optional[str] = None,
@@ -60,7 +70,7 @@ class SharedParamBuffer:
         size = _HEADER.size + self.capacity
         if create:
             self._shm = shared_memory.SharedMemory(create=True, size=size)
-            _HEADER.pack_into(self._shm.buf, 0, 0, 0)
+            _HEADER.pack_into(self._shm.buf, 0, 0, 0, 0)
         else:
             self._shm = shared_memory.SharedMemory(name=name)
         self._owner = create
@@ -74,15 +84,19 @@ class SharedParamBuffer:
         return _HEADER.unpack_from(self._shm.buf, 0)[0] // 2
 
     def write(self, payload: bytes) -> int:
+        import zlib
+
         if len(payload) > self.capacity:
             raise ValueError(
                 f"snapshot of {len(payload)} bytes exceeds shared buffer "
                 f"capacity {self.capacity}"
             )
-        v, _ = _HEADER.unpack_from(self._shm.buf, 0)
-        _HEADER.pack_into(self._shm.buf, 0, v + 1, len(payload))  # odd: in flight
+        v, _, _ = _HEADER.unpack_from(self._shm.buf, 0)
+        _HEADER.pack_into(self._shm.buf, 0, v + 1, len(payload), 0)  # odd: in flight
         self._shm.buf[_HEADER.size:_HEADER.size + len(payload)] = payload
-        _HEADER.pack_into(self._shm.buf, 0, v + 2, len(payload))  # even: committed
+        _HEADER.pack_into(                                     # even: committed
+            self._shm.buf, 0, v + 2, len(payload), zlib.crc32(payload)
+        )
         return (v + 2) // 2
 
     def read(self, have_version: int = -1,
@@ -93,17 +107,20 @@ class SharedParamBuffer:
         writer died mid-write, leaving the version odd), returns None so
         callers keep polling their own stop conditions instead of hanging.
         """
+        import zlib
+
         deadline = time.monotonic() + timeout
         while True:
-            v1, length = _HEADER.unpack_from(self._shm.buf, 0)
+            v1, length, _ = _HEADER.unpack_from(self._shm.buf, 0)
             if v1 % 2 == 0:
                 if v1 // 2 <= have_version or length == 0:
                     return None
                 payload = bytes(self._shm.buf[_HEADER.size:_HEADER.size + length])
-                v2, _ = _HEADER.unpack_from(self._shm.buf, 0)
-                if v1 == v2:
+                v2, _, crc = _HEADER.unpack_from(self._shm.buf, 0)
+                if v1 == v2 and zlib.crc32(payload) == crc:
                     return payload, v1 // 2
-                # torn read: a write landed mid-copy — retry
+                # torn read: a write landed mid-copy, or (weakly-ordered
+                # hosts) payload stores weren't yet visible — retry
             if time.monotonic() > deadline:
                 return None
             time.sleep(0.0005)
@@ -301,7 +318,12 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                 return
             time.sleep(0.01)
         while not stop_evt.is_set() and fleet.step_count < steps_budget:
-            chunks, stats = fleet.collect(quantum, param_source=source)
+            # Clamp the final quantum: the budget bounds TOTAL fleet steps
+            # across incarnations, so the last collect must land exactly.
+            chunks, stats = fleet.collect(
+                min(quantum, steps_budget - fleet.step_count),
+                param_source=source,
+            )
             for c in chunks:
                 xp_queue.put((
                     "xp", worker_id, fleet.param_version,
@@ -366,6 +388,7 @@ class ProcessActorPool:
         self.episodes: List[tuple] = []
         self.last_versions = {}   # worker_id -> param version in latest chunk
         self.finished_workers = set()
+        self.final_steps = {}     # worker_id -> fleet steps at clean "done"
         self.worker_errors = {}   # FATAL errors (restart budget exhausted)
         self.max_restarts = int(max_restarts)
         self.restarts = 0
@@ -475,6 +498,15 @@ class ProcessActorPool:
                 self.episodes.extend(msg[2])
             elif kind == "done":
                 self.finished_workers.add(msg[1])
+                # Cumulative fleet steps across incarnations (each "done"
+                # reports its own incarnation's count).  Restart-free runs
+                # land on actor.T exactly (the budget clamp in _worker_main);
+                # after a restart the respawn budget comes from chunk-based
+                # accounting, so the total is clamp-accurate only to the
+                # flush cadence.
+                self.final_steps[msg[1]] = (
+                    self.final_steps.get(msg[1], 0) + msg[2]
+                )
             elif kind == "error":
                 # Recorded for supervise(): respawnable until the restart
                 # budget runs out, fatal after.

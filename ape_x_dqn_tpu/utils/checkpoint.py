@@ -73,16 +73,22 @@ def save_checkpoint(
     step = int(jax.device_get(state.step))
     path = _step_dir(root, step)
     os.makedirs(path, exist_ok=True)
+    # Replay shard FIRST: the state/ dir is the commit marker latest_step
+    # keys on, so every other artifact of this step must be on disk before
+    # it lands — a crash between the two writes must yield an uncommitted
+    # dir, never a "committed" checkpoint missing its replay leg (the
+    # multi-host call site orders all hosts' shards before the state commit
+    # with a barrier; this is the same ordering inside one host).
+    if replay is not None:
+        np.savez(
+            os.path.join(path, f"replay{replay_suffix}.npz"),
+            **replay.state_dict(),
+        )
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(
             os.path.join(path, "state"),
             jax.device_get(state),
             force=True,
-        )
-    if replay is not None:
-        np.savez(
-            os.path.join(path, f"replay{replay_suffix}.npz"),
-            **replay.state_dict(),
         )
     if keep is not None:
         _prune(root, keep)

@@ -148,6 +148,38 @@ class TestEndToEnd:
         assert np.isfinite(result.get("learner/loss", 0.0))
 
 
+class TestBudgetAccounting:
+    def test_worker_lands_on_T_exactly(self):
+        """Process-mode twin of the thread fleet's exact-T clamp: a quantum
+        that doesn't divide actor.T must not overshoot the budget."""
+        from ape_x_dqn_tpu.runtime.process_actors import (
+            ProcessActorPool,
+            network_and_template,
+        )
+
+        cfg = ApexConfig()
+        cfg.network = "mlp"
+        cfg.env.name = "chain:6"
+        cfg.actor.mode = "process"
+        cfg.actor.num_workers = 1
+        cfg.actor.num_actors = 2
+        cfg.actor.T = 53  # 53 % 8 != 0
+        cfg.actor.flush_every = 8
+        cfg.validate()
+        pool = ProcessActorPool(cfg, num_workers=1, quantum=8)
+        try:
+            _, _, template = network_and_template(cfg)
+            pool.publish(template)
+            pool.start()
+            deadline = time.monotonic() + 120.0
+            while not pool.finished and time.monotonic() < deadline:
+                pool.poll(max_items=64, timeout=0.05)
+            assert pool.finished and not pool.worker_errors
+            assert pool.final_steps == {0: 53}
+        finally:
+            pool.stop()
+
+
 class TestElasticRecovery:
     def test_sigkilled_worker_respawns_and_feeds_again(self):
         """SURVEY §5 failure detection: a worker killed mid-run (no error
