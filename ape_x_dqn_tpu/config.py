@@ -89,11 +89,14 @@ class LearnerConfig:
     # each dispatch runs steps_per_call sample/train/restamp steps — the
     # throughput mode; False = host replay + per-step train (golden path).
     device_replay: bool = False
-    # Data-parallel learner over an N-device mesh (parallel/dp.py): batches
-    # shard over the ``data`` axis, XLA inserts the gradient all-reduce
-    # over ICI, priorities gather back per shard — BASELINE.md config 4.
-    # Requires the host-replay path (device_replay=False) and
-    # replay_sample_size % data_parallel == 0.
+    # Data-parallel learner over an N-device mesh.  With device_replay=False
+    # (parallel/dp.py): batches shard over ``data``, XLA inserts the
+    # gradient all-reduce over ICI, priorities gather back per shard —
+    # BASELINE.md config 4.  With device_replay=True (replay/device_dp.py):
+    # the HBM ring shards per device and the fused K-step scan runs SPMD
+    # with the all-reduce inside the scan body — both fast paths combined.
+    # Requires replay_sample_size % data_parallel == 0 (and capacity %
+    # data_parallel == 0 in the fused mode).
     data_parallel: int = 1
     steps_per_call: int = 128             # K steps fused per dispatch
     # HBM-traffic knobs ("bfloat16" | None): reduced-precision RMSProp
@@ -165,11 +168,14 @@ class ApexConfig:
             (l.loss in ("huber", "squared"), f"unknown loss kind: {l.loss}"),
             (l.steps_per_call >= 1, "learner.steps_per_call must be >= 1"),
             (l.data_parallel >= 1, "learner.data_parallel must be >= 1"),
-            (l.data_parallel == 1 or not l.device_replay,
-             "learner.data_parallel > 1 requires device_replay=False "
-             "(the mesh learner runs the host-replay path)"),
             (l.replay_sample_size % l.data_parallel == 0,
              "learner.replay_sample_size must be divisible by data_parallel"),
+            # Fused + DP (replay/device_dp.py): each device owns an equal
+            # ring shard, so capacity must split evenly.
+            (not (l.device_replay and l.data_parallel > 1)
+             or r.capacity % l.data_parallel == 0,
+             "replay.capacity must be divisible by learner.data_parallel "
+             "when device_replay=True (per-device HBM ring shards)"),
             (not l.sample_ahead or l.device_replay,
              "learner.sample_ahead=True requires device_replay=True "
              "(it configures the fused HBM-replay scan)"),

@@ -203,6 +203,7 @@ def build_train_step(
     use_is_weights: bool = True,
     priority_epsilon: float = 1e-6,
     sync_in_step: bool = True,
+    grad_reduce_axis: str | None = None,
     jit: bool = True,
 ) -> Callable[[TrainState, PrioritizedBatch], Tuple[TrainState, StepMetrics]]:
     """Build the fused step.  All knobs are static — baked into the XLA program.
@@ -213,6 +214,14 @@ def build_train_step(
     ``jnp.where`` tree-map rewrites the full target pytree in HBM every step,
     measured ~95 µs/step on a v5e for a 3.4M-param net, all wasted between
     the every-2500-step syncs).
+
+    ``grad_reduce_axis``: set to a mesh axis name when the step runs inside
+    ``shard_map`` with the batch sharded over that axis (the sharded fused
+    learner, replay/device_dp.py) — gradients and scalar metrics all-reduce
+    over it explicitly (``pmean``/``pmax`` over ICI), making the optimizer
+    update identical on every shard.  Under plain ``jit``/pjit leave it
+    ``None``: XLA's SPMD partitioner inserts the all-reduce itself from the
+    batch sharding (parallel/dp.py).  Per-row priorities stay per-shard.
     """
 
     def loss_fn(params, target_params, batch: PrioritizedBatch):
@@ -235,9 +244,19 @@ def build_train_step(
         (loss, (delta, q_values)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params, state.target_params, batch)
-        # When the batch is sharded over a data axis under pjit/shard_map, the
-        # mean inside loss_fn makes XLA insert the gradient all-reduce over
-        # ICI automatically — no explicit collective needed here.
+        # Under plain pjit the mean inside loss_fn makes XLA insert the
+        # gradient all-reduce over ICI automatically.  Inside shard_map
+        # (varying-axes AD semantics): the params enter unvarying while the
+        # batch is varying, so jax's transpose ALREADY psums the param
+        # cotangents over the axis — grads arrive as Σ_shards(local-mean
+        # grads).  Dividing by the axis extent yields the global batch mean
+        # (equal-size shards); an explicit pmean here would double-count
+        # (measured: exactly n× updates).  The scalar loss is still
+        # per-shard varying and needs a real pmean for reporting.
+        if grad_reduce_axis is not None:
+            n_sh = jax.lax.psum(1, grad_reduce_axis)
+            grads = jax.tree_util.tree_map(lambda g: g / n_sh, grads)
+            loss = jax.lax.pmean(loss, grad_reduce_axis)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         step = state.step + 1
@@ -254,12 +273,19 @@ def build_train_step(
             )
         else:
             new_target = state.target_params
+        mean_abs_td = jnp.mean(jnp.abs(delta))
+        max_abs_td = jnp.max(jnp.abs(delta))
+        mean_q = jnp.mean(q_values)
+        if grad_reduce_axis is not None:
+            mean_abs_td = jax.lax.pmean(mean_abs_td, grad_reduce_axis)
+            max_abs_td = jax.lax.pmax(max_abs_td, grad_reduce_axis)
+            mean_q = jax.lax.pmean(mean_q, grad_reduce_axis)
         metrics = StepMetrics(
             loss=loss,
-            mean_abs_td=jnp.mean(jnp.abs(delta)),
-            max_abs_td=jnp.max(jnp.abs(delta)),
+            mean_abs_td=mean_abs_td,
+            max_abs_td=max_abs_td,
             priorities=losses.priorities_from_td(delta, priority_epsilon),
-            mean_q=jnp.mean(q_values),
+            mean_q=mean_q,
         )
         new_state = TrainState(
             params=new_params,

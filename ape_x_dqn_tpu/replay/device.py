@@ -100,12 +100,13 @@ def device_replay_sample(
     rng: jax.Array,
     batch_size: int,
     beta: jax.Array | float = 0.4,
+    axis_name: str | None = None,
 ) -> PrioritizedBatch:
     """Stratified proportional sample with IS weights, fully on device.
 
     The K=1 case of ``device_replay_sample_many`` (single implementation —
     the strict-PER path and the sample-ahead path cannot diverge)."""
-    batch = device_replay_sample_many(state, rng, 1, batch_size, beta)
+    batch = device_replay_sample_many(state, rng, 1, batch_size, beta, axis_name)
     return jax.tree_util.tree_map(lambda a: a[0], batch)
 
 
@@ -115,6 +116,7 @@ def device_replay_sample_many(
     num_batches: int,
     batch_size: int,
     beta: jax.Array | float = 0.4,
+    axis_name: str | None = None,
 ) -> PrioritizedBatch:
     """Sample K stratified batches from the *current* priorities in one
     batched inverse-CDF call + one row gather (leaves get leading [K, B]).
@@ -131,6 +133,15 @@ def device_replay_sample_many(
     steps of staleness, the same order the async Ape-X pipeline already
     tolerates between actor-priority computation and learner restamp
     (reference's actors/learner run fully desynchronized).
+
+    ``axis_name``: when called per-shard inside ``shard_map`` (replay/
+    device_dp.py — each device samples ``batch_size`` rows from its OWN
+    ring shard), the IS weights must correct for the *actual* sampling
+    law: row i of shard s is drawn with q_i = (mass_i / shard_total) / n
+    (shards contribute equally, proportional within a shard), so
+    w_i = (N_global · q_i)^-β, normalized by the **global** batch max
+    (``pmax`` over the axis).  With ``None`` this reduces to the
+    single-ring law exactly.
     """
     K, B = num_batches, batch_size
     total = jnp.sum(state.mass)
@@ -142,10 +153,20 @@ def device_replay_sample_many(
     size_i = jnp.maximum(jnp.minimum(state.count, state.capacity), 1)
     idx = jnp.minimum(idx, size_i - 1)  # zero-mass guard (see sample above)
     probs = state.mass[idx] / jnp.maximum(total, 1e-12)
+    if axis_name is None:
+        n_shards = 1
+        size_global = size_i
+    else:
+        n_shards = jax.lax.psum(1, axis_name)
+        size_global = jax.lax.psum(size_i, axis_name)
     weights = jnp.power(
-        jnp.maximum(size_i.astype(jnp.float32) * probs, 1e-12), -beta
+        jnp.maximum(size_global.astype(jnp.float32) * probs / n_shards, 1e-12),
+        -beta,
     ).reshape(K, B)
-    weights = weights / jnp.max(weights, axis=1, keepdims=True)
+    wmax = jnp.max(weights, axis=1, keepdims=True)
+    if axis_name is not None:
+        wmax = jax.lax.pmax(wmax, axis_name)
+    weights = weights / wmax
     idx2 = idx.reshape(K, B)
     return PrioritizedBatch(
         transition=NStepTransition(
@@ -203,6 +224,72 @@ def device_replay_update_priorities(
     return state.replace(mass=state.mass.at[indices].set(mass))
 
 
+def fused_scan_body(
+    train_step_fn,
+    train_state,
+    replay_state: DeviceReplayState,
+    beta,
+    rng: jax.Array,
+    *,
+    steps_per_call: int,
+    batch_size: int,
+    priority_exponent: float,
+    target_sync_freq: int | None,
+    sample_ahead: bool,
+    axis_name: str | None = None,
+):
+    """The K-step [sample → train → restamp] scan + hoisted target sync —
+    the ONE body shared by the single-device builder below and the sharded
+    builder (replay/device_dp.py, where it runs per shard inside shard_map
+    with ``axis_name="data"`` and a per-shard batch size)."""
+    K, B = steps_per_call, batch_size
+    step_before = train_state.step
+
+    if sample_ahead:
+        batches = device_replay_sample_many(
+            replay_state, rng, K, B, beta, axis_name
+        )
+
+        def body_pre(t_state, batch):
+            t_state, metrics = train_step_fn(t_state, batch)
+            return t_state, metrics
+
+        train_state, metrics = jax.lax.scan(body_pre, train_state, batches)
+        replay_state = device_replay_restamp_last(
+            replay_state, batches.indices, metrics.priorities,
+            priority_exponent,
+        )
+    else:
+
+        def body(carry, step_rng):
+            t_state, r_state = carry
+            batch = device_replay_sample(r_state, step_rng, B, beta, axis_name)
+            t_state, metrics = train_step_fn(t_state, batch)
+            r_state = device_replay_update_priorities(
+                r_state, batch.indices, metrics.priorities, priority_exponent
+            )
+            return (t_state, r_state), metrics
+
+        rngs = jax.random.split(rng, K)
+        (train_state, replay_state), metrics = jax.lax.scan(
+            body, (train_state, replay_state), rngs
+        )
+    if target_sync_freq is not None:
+        crossed = (train_state.step // target_sync_freq) > (
+            step_before // target_sync_freq
+        )
+        train_state = train_state.replace(
+            target_params=jax.tree_util.tree_map(
+                lambda online, target: jnp.where(
+                    crossed, online.astype(target.dtype), target
+                ),
+                train_state.params,
+                train_state.target_params,
+            )
+        )
+    return train_state, replay_state, metrics
+
+
 def build_fused_learn_step(
     train_step_fn,
     batch_size: int,
@@ -253,55 +340,16 @@ def build_fused_learn_step(
     """
 
     def fused(train_state, replay_state, chunk, chunk_priorities, beta, rng):
-        step_before = train_state.step
         if include_ingest:
             replay_state = device_replay_add(
                 replay_state, chunk, chunk_priorities, priority_exponent
             )
-
-        if sample_ahead:
-            batches = device_replay_sample_many(
-                replay_state, rng, steps_per_call, batch_size, beta
-            )
-
-            def body_pre(t_state, batch):
-                t_state, metrics = train_step_fn(t_state, batch)
-                return t_state, metrics
-
-            train_state, metrics = jax.lax.scan(body_pre, train_state, batches)
-            replay_state = device_replay_restamp_last(
-                replay_state, batches.indices, metrics.priorities,
-                priority_exponent,
-            )
-        else:
-
-            def body(carry, step_rng):
-                t_state, r_state = carry
-                batch = device_replay_sample(r_state, step_rng, batch_size, beta)
-                t_state, metrics = train_step_fn(t_state, batch)
-                r_state = device_replay_update_priorities(
-                    r_state, batch.indices, metrics.priorities, priority_exponent
-                )
-                return (t_state, r_state), metrics
-
-            rngs = jax.random.split(rng, steps_per_call)
-            (train_state, replay_state), metrics = jax.lax.scan(
-                body, (train_state, replay_state), rngs
-            )
-        if target_sync_freq is not None:
-            crossed = (train_state.step // target_sync_freq) > (
-                step_before // target_sync_freq
-            )
-            train_state = train_state.replace(
-                target_params=jax.tree_util.tree_map(
-                    lambda online, target: jnp.where(
-                        crossed, online.astype(target.dtype), target
-                    ),
-                    train_state.params,
-                    train_state.target_params,
-                )
-            )
-        return train_state, replay_state, metrics
+        return fused_scan_body(
+            train_step_fn, train_state, replay_state, beta, rng,
+            steps_per_call=steps_per_call, batch_size=batch_size,
+            priority_exponent=priority_exponent,
+            target_sync_freq=target_sync_freq, sample_ahead=sample_ahead,
+        )
 
     if not include_ingest:
         inner = fused

@@ -117,10 +117,18 @@ class Components:
 
     def make_fused_learner(self):
         """The device-resident fused learner (HBM replay + K-step scan) —
-        the ``learner.device_replay=True`` throughput mode."""
+        the ``learner.device_replay=True`` throughput mode.  With
+        ``learner.data_parallel > 1`` the ring shards over a data mesh and
+        the scan runs SPMD with the grad all-reduce inside
+        (replay/device_dp.py — BASELINE config 4's fused spelling)."""
         from ape_x_dqn_tpu.runtime.fused_learner import FusedDeviceLearner
 
         cfg = self.cfg
+        mesh = None
+        if cfg.learner.data_parallel > 1:
+            from ape_x_dqn_tpu.parallel import make_mesh
+
+            mesh = make_mesh(num_devices=cfg.learner.data_parallel)
         # The fused scan syncs targets at call boundaries, exact only when
         # freq % K == 0 — round the freq down to a multiple of K (never
         # below K) so the default config (2500, K=128) syncs exactly rather
@@ -140,6 +148,7 @@ class Components:
             target_sync_freq=freq,
             loss_kind=cfg.learner.loss,
             sample_ahead=cfg.learner.sample_ahead,
+            mesh=mesh,
         )
 
     def make_fleet(self, seed_offset: int = 0) -> ActorFleet:

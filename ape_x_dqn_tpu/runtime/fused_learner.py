@@ -55,33 +55,93 @@ class FusedDeviceLearner:
         target_sync_freq: int = 2500,
         loss_kind: str = "huber",
         sample_ahead: bool = False,
+        mesh=None,
     ):
-        self._state = state
-        self._replay = init_device_replay(capacity, obs_shape)
+        """``mesh``: a ``(data, ...)`` jax Mesh to run the fused loop
+        data-parallel (replay/device_dp.py — per-device ring shards, grad
+        all-reduce inside the K-step scan).  ``None`` = single device."""
         self._capacity = int(capacity)
         self._batch_size = int(batch_size)
         self.steps_per_call = int(steps_per_call)
         self._ingest_block = int(ingest_block)
-        step_fn = build_train_step(
-            network,
-            optimizer,
-            loss_kind=loss_kind,
-            sync_in_step=False,
-            jit=False,
-        )
-        self._fused = build_fused_learn_step(
-            step_fn,
-            batch_size,
-            steps_per_call=self.steps_per_call,
-            priority_exponent=priority_exponent,
-            target_sync_freq=target_sync_freq,
-            include_ingest=False,
-            sample_ahead=sample_ahead,
-        )
-        self._add = jax.jit(
-            lambda r, t, p: device_replay_add(r, t, p, priority_exponent),
-            donate_argnums=(0,),
-        )
+        self._mesh = mesh
+        if mesh is None:
+            self._state = state
+            self._replay = init_device_replay(capacity, obs_shape)
+            step_fn = build_train_step(
+                network,
+                optimizer,
+                loss_kind=loss_kind,
+                sync_in_step=False,
+                jit=False,
+            )
+            self._fused = build_fused_learn_step(
+                step_fn,
+                batch_size,
+                steps_per_call=self.steps_per_call,
+                priority_exponent=priority_exponent,
+                target_sync_freq=target_sync_freq,
+                include_ingest=False,
+                sample_ahead=sample_ahead,
+            )
+            self._add = jax.jit(
+                lambda r, t, p: device_replay_add(r, t, p, priority_exponent),
+                donate_argnums=(0,),
+            )
+            self._add_granularity = 1
+            self._place_rows = jnp.asarray
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ape_x_dqn_tpu.replay.device_dp import (
+                build_sharded_fused_learn_step,
+                build_sharded_replay_add,
+                init_sharded_device_replay,
+            )
+
+            n = mesh.shape["data"]
+            if self._ingest_block % n:
+                raise ValueError(
+                    f"ingest_block {ingest_block} must divide by the "
+                    f"data-axis extent {n}"
+                )
+            # Train state replicated over the mesh; the grad pmean inside
+            # the step keeps every replica identical.  Identity-jit (not
+            # device_put): device_put may alias the caller's buffers when
+            # layouts line up, and the fused call donates this state — an
+            # alias would delete the caller's arrays out from under it.
+            self._state = jax.jit(
+                lambda s: s, out_shardings=NamedSharding(mesh, P())
+            )(state)
+            self._replay = init_sharded_device_replay(
+                capacity, obs_shape, mesh
+            )
+            step_fn = build_train_step(
+                network,
+                optimizer,
+                loss_kind=loss_kind,
+                sync_in_step=False,
+                grad_reduce_axis="data",
+                jit=False,
+            )
+            self._fused = build_sharded_fused_learn_step(
+                step_fn,
+                mesh,
+                batch_size,
+                steps_per_call=self.steps_per_call,
+                priority_exponent=priority_exponent,
+                target_sync_freq=target_sync_freq,
+                sample_ahead=sample_ahead,
+            )
+            self._add = build_sharded_replay_add(mesh, priority_exponent)
+            # Every ingest must split evenly across shards.
+            self._add_granularity = n
+            # Host rows go straight to their owning shard (device_put with
+            # the row sharding splits the numpy array host→device per
+            # shard); jnp.asarray would bounce the whole block through
+            # device 0 and reshard over ICI.
+            row_sh = NamedSharding(mesh, P("data"))
+            self._place_rows = lambda a: jax.device_put(np.asarray(a), row_sh)
         # Distinct per-seed sampling stream: fold a salt into the state's key
         # (reading a key word breaks — the high word is 0 for seeds < 2^32,
         # which made every seed sample identically; round-2 advisor finding).
@@ -157,39 +217,46 @@ class FusedDeviceLearner:
             sl = slice(i * m, (i + 1) * m)
             self._replay = self._add(
                 self._replay,
-                jax.tree_util.tree_map(lambda a: jnp.asarray(a[sl]), cat),
-                jnp.asarray(prio[sl]),
+                jax.tree_util.tree_map(lambda a: self._place_rows(a[sl]), cat),
+                self._place_rows(prio[sl]),
             )
             ingested += m
         rem = len(prio) - n_full * m
+        if rem and drain:
+            # Exact tail ingestion in g·2^k sub-blocks (g = shard
+            # granularity: rows per add must split evenly over the mesh's
+            # data axis; 1 single-device).  At most log2 compiled variants,
+            # cached by jit.
+            off = n_full * m
+            g = self._add_granularity
+            while rem >= g:
+                sub = g << ((rem // g).bit_length() - 1)  # max g·2^k <= rem
+                sl = slice(off, off + sub)
+                self._replay = self._add(
+                    self._replay,
+                    jax.tree_util.tree_map(
+                        lambda a: self._place_rows(a[sl]), cat
+                    ),
+                    self._place_rows(prio[sl]),
+                )
+                off += sub
+                rem -= sub
+                ingested += sub
         if rem:
-            if drain:
-                off = n_full * m
-                while rem:
-                    sub = 1 << (rem.bit_length() - 1)  # largest 2^k <= rem
-                    sl = slice(off, off + sub)
-                    self._replay = self._add(
-                        self._replay,
+            # Partial tail (or, sharded, a sub-granularity remainder) goes
+            # back to staging; checkpoints still lose nothing because
+            # state_dict snapshots staged rows alongside the ring.
+            with self._lock:
+                self._staged.insert(
+                    0,
+                    (
+                        prio[len(prio) - rem:],
                         jax.tree_util.tree_map(
-                            lambda a: jnp.asarray(a[sl]), cat
+                            lambda a: a[len(prio) - rem:], cat
                         ),
-                        jnp.asarray(prio[sl]),
-                    )
-                    off += sub
-                    rem -= sub
-                    ingested += sub
-            else:
-                with self._lock:  # push the partial tail back for next time
-                    self._staged.insert(
-                        0,
-                        (
-                            prio[n_full * m:],
-                            jax.tree_util.tree_map(
-                                lambda a: a[n_full * m:], cat
-                            ),
-                        ),
-                    )
-                    self._staged_rows += rem
+                    ),
+                )
+                self._staged_rows += rem
         self._size += ingested
         self._ingested_blocks += n_full
         return ingested
@@ -198,19 +265,29 @@ class FusedDeviceLearner:
 
     def state_dict(self) -> dict:
         """Snapshot the HBM replay ring to host numpy (the replay leg of
-        checkpoint/resume — utils/checkpoint.save_checkpoint(replay=self)).
-        Staged-but-uningested host rows are NOT included; runtimes ingest
-        with drain before checkpointing at shutdown."""
+        checkpoint/resume — utils/checkpoint.save_checkpoint(replay=self)),
+        plus any staged-but-uningested host rows (``staged_*`` arrays), so
+        a checkpoint loses nothing regardless of block alignment."""
         r = jax.device_get(self._replay)
-        return {
+        out = {
             "obs": r.obs, "next_obs": r.next_obs, "action": r.action,
             "reward": r.reward, "discount": r.discount, "mass": r.mass,
             "cursor": np.asarray(r.cursor), "count": np.asarray(r.count),
         }
+        with self._lock:
+            staged = list(self._staged)
+        if staged:
+            cat = _concat_chunks([t for _, t in staged])
+            out["staged_prio"] = np.concatenate([p for p, _ in staged])
+            for f in ("obs", "action", "reward", "discount", "next_obs"):
+                out[f"staged_{f}"] = np.asarray(getattr(cat, f))
+        return out
 
     def load_state_dict(self, state: dict) -> None:
         """Restore the ring from a snapshot (same capacity/obs shape —
-        static HBM shapes make a resize a config error, not a migration)."""
+        static HBM shapes make a resize a config error, not a migration).
+        Staged rows in the snapshot re-enter staging and ingest on the
+        next learner tick."""
         import jax.numpy as jnp
 
         from ape_x_dqn_tpu.replay.device import DeviceReplayState
@@ -221,17 +298,43 @@ class FusedDeviceLearner:
             raise ValueError(
                 f"replay snapshot shape {got} != configured ring {want}"
             )
+        if tuple(np.shape(state["cursor"])) != tuple(self._replay.cursor.shape):
+            raise ValueError(
+                f"replay snapshot shard layout {np.shape(state['cursor'])} "
+                f"!= configured {tuple(self._replay.cursor.shape)} — the "
+                "data_parallel extent must match the snapshot's"
+            )
+        if self._mesh is not None:
+            # Each host leaf transfers straight to its owning shards
+            # (device_put with the live sharding splits the numpy array) —
+            # never materialize the aggregate-HBM-sized ring on one device.
+            place = lambda key, live: jax.device_put(  # noqa: E731
+                np.asarray(state[key]), live.sharding
+            )
+        else:
+            place = lambda key, live: jnp.asarray(state[key])  # noqa: E731
         self._replay = DeviceReplayState(
-            obs=jnp.asarray(state["obs"]),
-            next_obs=jnp.asarray(state["next_obs"]),
-            action=jnp.asarray(state["action"]),
-            reward=jnp.asarray(state["reward"]),
-            discount=jnp.asarray(state["discount"]),
-            mass=jnp.asarray(state["mass"]),
-            cursor=jnp.asarray(state["cursor"]),
-            count=jnp.asarray(state["count"]),
+            obs=place("obs", self._replay.obs),
+            next_obs=place("next_obs", self._replay.next_obs),
+            action=place("action", self._replay.action),
+            reward=place("reward", self._replay.reward),
+            discount=place("discount", self._replay.discount),
+            mass=place("mass", self._replay.mass),
+            cursor=place("cursor", self._replay.cursor),
+            count=place("count", self._replay.count),
         )
-        self._size = int(state["count"])
+        self._size = int(np.sum(state["count"]))
+        if "staged_prio" in state and len(state["staged_prio"]):
+            self.add_chunk(
+                state["staged_prio"],
+                NStepTransition(
+                    obs=state["staged_obs"],
+                    action=state["staged_action"],
+                    reward=state["staged_reward"],
+                    discount=state["staged_discount"],
+                    next_obs=state["staged_next_obs"],
+                ),
+            )
 
     def train(self, beta: float):
         """One fused call: K steps of sample/train/restamp.  Returns the

@@ -1,0 +1,344 @@
+"""Fused device replay × data parallelism (replay/device_dp.py).
+
+Round-3 verdict top item: the two fast paths must combine.  These tests run
+on the conftest's 8 virtual CPU devices and pin the sharded semantics
+against single-device oracles:
+
+  * ingest splits chunks contiguously over shards' rings;
+  * the per-shard sampler's indices and IS weights match a numpy
+    inverse-CDF oracle of the realized sampling law q = (m_i/M_s)/n;
+  * the strict-PER fused scan (sample → train with grad all-reduce →
+    restamp, K steps) matches a hand-run emulation built from the
+    single-device sample/update functions + a concatenated-batch train
+    step — params AND per-shard restamped masses;
+  * the async pipeline runs end-to-end in fused+DP mode;
+  * checkpoints round-trip the sharded ring (with staged rows).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.learner.train_step import (
+    build_train_step,
+    init_train_state,
+    make_optimizer,
+)
+from ape_x_dqn_tpu.models.dueling import DuelingMLP
+from ape_x_dqn_tpu.parallel import make_mesh
+from ape_x_dqn_tpu.replay.device import (
+    DeviceReplayState,
+    device_replay_sample,
+    device_replay_sample_many,
+    device_replay_update_priorities,
+)
+from ape_x_dqn_tpu.replay.device_dp import (
+    _local,
+    build_sharded_fused_learn_step,
+    build_sharded_replay_add,
+    init_sharded_device_replay,
+    replay_specs,
+)
+from ape_x_dqn_tpu.runtime.fused_learner import FusedDeviceLearner
+from ape_x_dqn_tpu.types import NStepTransition, PrioritizedBatch
+
+
+def np_chunk(M, obs_shape=(8,), seed=0):
+    r = np.random.default_rng(seed)
+    return NStepTransition(
+        obs=r.integers(0, 255, (M, *obs_shape), dtype=np.uint8),
+        action=r.integers(0, 3, (M,), dtype=np.int32),
+        reward=r.normal(size=(M,)).astype(np.float32),
+        discount=np.full((M,), 0.9, np.float32),
+        next_obs=r.integers(0, 255, (M, *obs_shape), dtype=np.uint8),
+    )
+
+
+class TestShardedIngest:
+    def test_chunk_splits_contiguously_over_shards(self):
+        n, C = 4, 64  # C_local = 16
+        mesh = make_mesh(num_devices=n)
+        state = init_sharded_device_replay(C, (8,), mesh)
+        add = build_sharded_replay_add(mesh)
+        chunk = np_chunk(32, seed=1)
+        state = add(state, jax.device_put(chunk), jnp.ones(32))
+        got = jax.device_get(state)
+        # Shard d's ring occupies global rows [d*16, (d+1)*16); its first 8
+        # slots hold chunk rows [d*8, (d+1)*8).
+        for d in range(n):
+            np.testing.assert_array_equal(
+                got.obs[d * 16: d * 16 + 8], chunk.obs[d * 8: (d + 1) * 8]
+            )
+        np.testing.assert_array_equal(np.asarray(got.cursor), [8] * n)
+        np.testing.assert_array_equal(np.asarray(got.count), [8] * n)
+
+    def test_capacity_must_divide(self):
+        mesh = make_mesh(num_devices=4)
+        with pytest.raises(ValueError, match="divide"):
+            init_sharded_device_replay(30, (8,), mesh)
+
+
+def _manual_global_state(mesh, n, C_local, mass_global):
+    """A FULL sharded ring with given integer masses and arbitrary rows."""
+    C = n * C_local
+    chunk = np_chunk(C, seed=7)
+    state = init_sharded_device_replay(C, (8,), mesh)
+    add = build_sharded_replay_add(mesh)
+    # Priorities whose ^0.6 mass we overwrite below; rows land contiguous.
+    state = add(state, jax.device_put(chunk), jnp.ones(C))
+    state = state.replace(
+        mass=jax.device_put(
+            jnp.asarray(mass_global, jnp.float32), state.mass.sharding
+        )
+    )
+    return state, chunk
+
+
+class TestShardedSampler:
+    def test_indices_and_weights_match_numpy_oracle(self):
+        """The realized per-shard law is q_i = (m_i / M_s) / n; indices come
+        from a stratified inverse-CDF over the shard's mass and weights are
+        (N_global · q_i)^-β normalized by the GLOBAL batch max."""
+        n, C_local, K, B = 4, 16, 3, 8
+        beta = 0.7
+        mesh = make_mesh(num_devices=n)
+        r = np.random.default_rng(3)
+        # Integer masses -> exact float32 prefix sums -> bit-exact oracle.
+        mass = r.integers(1, 50, n * C_local).astype(np.float32)
+        state, _ = _manual_global_state(mesh, n, C_local, mass)
+        rng = jax.random.PRNGKey(11)
+
+        def run(st, key):
+            def body(st_l):
+                loc = _local(st_l)
+                k = jax.random.fold_in(key, jax.lax.axis_index("data"))
+                b = device_replay_sample_many(
+                    loc, k, K, B, beta, axis_name="data"
+                )
+                return b.indices, b.is_weights
+
+            from jax.sharding import PartitionSpec as P
+
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(replay_specs(),),
+                out_specs=(P(None, "data"), P(None, "data")),
+            )(st)
+
+        idx_g, w_g = jax.device_get(run(state, rng))  # [K, n*B] each
+
+        # ---- numpy oracle ----
+        N_global = n * C_local  # every slot filled
+        want_idx = np.zeros((K, n * B), np.int64)
+        raw_w = np.zeros((K, n * B), np.float64)
+        for s in range(n):
+            m_s = mass[s * C_local:(s + 1) * C_local]
+            total = np.float32(m_s.sum())
+            u = np.asarray(
+                jax.random.uniform(jax.random.fold_in(rng, s), (K, B))
+            )
+            targets = (
+                (np.arange(B, dtype=np.float32)[None, :] + u)
+                * (total / np.float32(B))
+            ).astype(np.float32)
+            targets = np.minimum(targets, total * np.float32(1.0 - 1e-7))
+            cdf = np.cumsum(m_s, dtype=np.float32)
+            idx = np.searchsorted(cdf, targets, side="right")
+            idx = np.clip(idx, 0, C_local - 1)
+            q = m_s[idx] / total / n
+            want_idx[:, s * B:(s + 1) * B] = idx
+            raw_w[:, s * B:(s + 1) * B] = (N_global * q) ** (-beta)
+        want_w = raw_w / raw_w.max(axis=1, keepdims=True)
+
+        np.testing.assert_array_equal(idx_g, want_idx)
+        np.testing.assert_allclose(w_g, want_w, rtol=1e-5)
+
+
+class TestShardedFusedStrict:
+    def test_matches_concat_batch_emulation(self):
+        """The whole strict-PER fused call — K × [per-shard sample → train
+        with pmean'd grads → per-shard restamp] — against an emulation
+        from single-device pieces: per-shard sampling with hand-computed
+        global IS weights, ONE train step on the concatenated global batch,
+        per-shard priority updates.  Params and restamped masses agree."""
+        n, C_local, K, B_local = 2, 32, 3, 4
+        B = n * B_local
+        pexp, beta = 0.6, 0.5
+        mesh = make_mesh(num_devices=n)
+        r = np.random.default_rng(5)
+        mass = r.integers(1, 30, n * C_local).astype(np.float32)
+        state_g, chunk = _manual_global_state(mesh, n, C_local, mass)
+
+        net = DuelingMLP(num_actions=3, hidden_sizes=(16,))
+        # Plain SGD: linear in the gradient, so emulation mismatches surface
+        # as-is instead of being amplified to ±lr by RMSProp's rsqrt(nu≈0)
+        # (first steps of rmsprop are ~sign(g) — float noise flips signs).
+        # Debugged at K=1: loss/priorities agree to 1e-7 under rmsprop too.
+        import optax
+
+        opt = optax.sgd(1e-3)
+        t0 = init_train_state(
+            net, opt, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.uint8)
+        )
+        rng = jax.random.PRNGKey(42)
+
+        # --- sharded run ---
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        step_sh = build_train_step(
+            net, opt, loss_kind="huber", sync_in_step=False,
+            grad_reduce_axis="data", jit=False,
+        )
+        fused = build_sharded_fused_learn_step(
+            step_sh, mesh, B, steps_per_call=K,
+            priority_exponent=pexp, target_sync_freq=None,
+        )
+        t_repl = jax.jit(lambda s: s, out_shardings=NamedSharding(mesh, P()))(t0)
+        t_f, r_f, metrics = fused(t_repl, state_g, beta, rng)
+        got_params = jax.device_get(t_f.params)
+        got_mass = np.asarray(jax.device_get(r_f.mass))
+
+        # --- emulation ---
+        step_em = build_train_step(
+            net, opt, loss_kind="huber", sync_in_step=False, jit=False,
+        )
+        locals_ = []
+        for s in range(n):
+            sl = slice(s * C_local, (s + 1) * C_local)
+            locals_.append(DeviceReplayState(
+                obs=jnp.asarray(chunk.obs[sl]),
+                next_obs=jnp.asarray(chunk.next_obs[sl]),
+                action=jnp.asarray(chunk.action[sl], jnp.int32),
+                reward=jnp.asarray(chunk.reward[sl]),
+                discount=jnp.asarray(chunk.discount[sl]),
+                mass=jnp.asarray(mass[sl]),
+                cursor=jnp.zeros((), jnp.int32),
+                count=jnp.asarray(C_local, jnp.int32),
+            ))
+        rngs = [jax.random.split(jax.random.fold_in(rng, s), K)
+                for s in range(n)]
+        t_em = t0
+        N_global = float(n * C_local)
+        for k in range(K):
+            parts, idxs = [], []
+            for s in range(n):
+                b = device_replay_sample(locals_[s], rngs[s][k], B_local, beta)
+                parts.append(jax.device_get(b))
+                idxs.append(np.asarray(b.indices))
+            # Correct the IS weights to the sharded law (the single-ring
+            # sampler normalized per-shard with local N).
+            raw = []
+            for s in range(n):
+                m_s = np.asarray(locals_[s].mass)
+                q = m_s[idxs[s]] / m_s.sum() / n
+                raw.append((N_global * q) ** (-beta))
+            wmax = max(float(w.max()) for w in raw)
+            weights = np.concatenate([w / wmax for w in raw]).astype(np.float32)
+            batch = PrioritizedBatch(
+                transition=NStepTransition(
+                    obs=np.concatenate([p.transition.obs for p in parts]),
+                    action=np.concatenate([p.transition.action for p in parts]),
+                    reward=np.concatenate([p.transition.reward for p in parts]),
+                    discount=np.concatenate(
+                        [p.transition.discount for p in parts]
+                    ),
+                    next_obs=np.concatenate(
+                        [p.transition.next_obs for p in parts]
+                    ),
+                ),
+                indices=np.concatenate(idxs).astype(np.int32),
+                is_weights=weights,
+            )
+            t_em, m_em = step_em(t_em, jax.device_put(batch))
+            prios = np.asarray(m_em.priorities)
+            for s in range(n):
+                locals_[s] = device_replay_update_priorities(
+                    locals_[s], jnp.asarray(idxs[s]),
+                    jnp.asarray(prios[s * B_local:(s + 1) * B_local]), pexp,
+                )
+
+        want_params = jax.device_get(t_em.params)
+        for a, b in zip(jax.tree_util.tree_leaves(got_params),
+                        jax.tree_util.tree_leaves(want_params)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            )
+        want_mass = np.concatenate(
+            [np.asarray(l.mass) for l in locals_]
+        )
+        np.testing.assert_allclose(got_mass, want_mass, rtol=1e-5, atol=1e-7)
+        # The scan really ran K steps and losses were finite.
+        assert int(jax.device_get(t_f.step)) == K
+        assert np.isfinite(np.asarray(metrics.loss)).all()
+
+
+class TestFusedDPRuntime:
+    def test_pipeline_end_to_end(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+        from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
+
+        cfg = ApexConfig()
+        cfg.env.name = "chain:6"
+        cfg.network = "mlp"
+        cfg.actor.num_actors = 4
+        cfg.actor.flush_every = 8
+        cfg.learner.device_replay = True
+        cfg.learner.data_parallel = 4
+        cfg.learner.steps_per_call = 8
+        cfg.learner.min_replay_mem_size = 128
+        cfg.learner.replay_sample_size = 16
+        cfg.learner.max_grad_norm = None
+        cfg.replay.capacity = 2048
+        pipe = AsyncPipeline(cfg, log_every=32)
+        out = pipe.run(learner_steps=64, warmup_timeout=120)
+        assert out["step"] >= 64
+        assert np.isfinite(out["learner/loss"])
+        assert out["replay_size"] >= 128
+
+    def test_capacity_divisibility_validated(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+
+        cfg = ApexConfig()
+        cfg.learner.device_replay = True
+        cfg.learner.data_parallel = 4
+        cfg.replay.capacity = 100_002
+        with pytest.raises(ValueError, match="capacity must be divisible"):
+            cfg.validate()
+
+
+class TestShardedSnapshot:
+    def test_roundtrip_with_staged_rows(self):
+        net = DuelingMLP(num_actions=3, hidden_sizes=(16,))
+        opt = make_optimizer("adam", learning_rate=1e-3)
+        mesh = make_mesh(num_devices=4)
+
+        def make(seed):
+            st = init_train_state(
+                net, opt, jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.uint8)
+            )
+            return FusedDeviceLearner(
+                net, opt, st, (8,), capacity=256, batch_size=16,
+                steps_per_call=4, ingest_block=32, mesh=mesh,
+            )
+
+        fl = make(0)
+        fl.add_chunk(np.ones(64, np.float32), np_chunk(64, seed=1))
+        fl.ingest_staged()
+        # 10 staged rows: 8 drain via the granularity decomposition, 2 stay
+        # staged (< n shards) — the snapshot must carry them anyway.
+        fl.add_chunk(np.ones(10, np.float32), np_chunk(10, seed=2))
+        fl.ingest_staged(drain=True)
+        assert fl.size == 72 and fl.staged_rows == 2
+        fl.train(beta=0.4)
+        sd = fl.state_dict()
+        assert len(sd["staged_prio"]) == 2
+
+        fl2 = make(9)
+        fl2.load_state_dict(sd)
+        assert fl2.size == 72 and fl2.staged_rows == 2
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(fl2._replay.mass)),
+            np.asarray(jax.device_get(fl._replay.mass)),
+        )
+        m = fl2.train(beta=0.4)
+        assert np.isfinite(np.asarray(m.loss)).all()
