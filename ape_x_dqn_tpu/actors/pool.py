@@ -114,6 +114,7 @@ class ActorFleet:
         seed: int = 0,
         epsilon_index_offset: int = 0,
         epsilon_total: int | None = None,
+        emission: str = "overlapping",
     ):
         self.envs = SyncVectorEnv(env_fns)
         self.network = network
@@ -121,6 +122,18 @@ class ActorFleet:
         self.gamma = float(gamma)
         self.flush_every = int(flush_every)
         self.sync_every = int(sync_every)
+        # Emission cadence: "overlapping" emits every step as a window start
+        # (stride 1, the Ape-X paper's sliding window); "strided" emits only
+        # n-aligned starts (stride n — the reference's non-overlapping
+        # advance-by-n buffer, reference actor.py:44-70).
+        if emission not in ("overlapping", "strided"):
+            raise ValueError(f"unknown emission mode: {emission}")
+        self.stride = self.n_step if emission == "strided" else 1
+        if self.flush_every < self.stride:
+            raise ValueError(
+                "strided emission needs flush_every >= num_steps (a flush "
+                "window shorter than the stride can contain no aligned start)"
+            )
         N = self.envs.num_envs
         # When this fleet is one shard of a larger actor set (process-
         # parallel workers each own a slice), the ε-ladder spans the GLOBAL
@@ -199,8 +212,11 @@ class ActorFleet:
         self._rows = min(self._rows + 1, self._H)
 
     def _flush(self) -> Chunk:
-        """Emit flush_every overlapping n-step transitions per actor from the
-        history ring.  Requires a full ring (_rows == H).
+        """Emit n-step transitions per actor from the history ring —
+        window starts 0..F-1 of the flush frame (all of them overlapping
+        at stride 1; the GLOBALLY n-aligned subset at stride n, the
+        reference's non-overlapping emission).  Requires a full ring
+        (_rows == H).
 
         Called after ``_step_count`` was incremented past the newest row, so
         the oldest row (global step ``_step_count − H``) lives at slot
@@ -211,13 +227,24 @@ class ActorFleet:
         n, F, N = self.n_step, self.flush_every, self.num_actors
         order = (np.arange(self._H) + self._step_count) % self._H
         # Window starts 0..F-1; start+n <= H-1 indexes stay in the ring.
+        # Strided emission keeps only starts that are multiples of the
+        # stride in GLOBAL step numbering (s0 = the oldest row's global
+        # step), so windows stay non-overlapping across flush boundaries
+        # exactly like the reference's advance-by-n buffer
+        # (reference actor.py:44-70).
+        starts = np.arange(F)
+        if self.stride > 1:
+            s0 = self._step_count - self._H
+            starts = starts[(s0 + starts) % self.stride == 0]
+        S = len(starts)
         rewards = self._hist_reward[order[: F + n - 1]]
         discounts = self._hist_discount[order[: F + n - 1]]
         returns, boot = nstep_returns_np(rewards, discounts, n)  # [F, N]
-        next_idx = order[np.arange(F) + n]
-        obs = self._hist_obs[order[:F]]                # [F, N, *obs]
-        next_obs = self._hist_obs[next_idx]            # [F, N, *obs]
-        qtaken = self._hist_qtaken[order[:F]]
+        returns, boot = returns[starts], boot[starts]            # [S, N]
+        next_idx = order[starts + n]
+        obs = self._hist_obs[order[starts]]            # [S, N, *obs]
+        next_obs = self._hist_obs[next_idx]            # [S, N, *obs]
+        qtaken = self._hist_qtaken[order[starts]]
         boot_qmax = self._hist_qmax[next_idx]
         truncs = self._hist_trunc[order[: F + n - 1]]  # [F+n-1, N]
         if truncs.any():
@@ -233,22 +260,22 @@ class ActorFleet:
             qmax_seq = self._hist_qmax[order[: F + n - 1]]
             alive = np.ones(boot.shape, bool)          # no done before k
             for k in range(n):
-                m = alive & truncs[k:k + F]
+                m = alive & truncs[starts + k]
                 if m.any():
                     boot[m] = self.gamma ** (k + 1)
-                    next_obs[m] = trunc_obs_seq[k:k + F][m]
-                    boot_qmax[m] = qmax_seq[k:k + F][m]
-                alive &= discounts[k:k + F] != 0.0
+                    next_obs[m] = trunc_obs_seq[starts + k][m]
+                    boot_qmax[m] = qmax_seq[starts + k][m]
+                alive &= discounts[starts + k] != 0.0
         # Actor priority rule: |n-step TD error| with max-Q bootstrap
         # (reference actor.py:138-142), per transition (not collapsed).
         td = returns + boot * boot_qmax - qtaken
         priorities = np.abs(td).astype(np.float32).reshape(-1)
         transitions = NStepTransition(
-            obs=obs.reshape(F * N, *obs.shape[2:]),
-            action=self._hist_action[order[:F]].reshape(-1),
+            obs=obs.reshape(S * N, *obs.shape[2:]),
+            action=self._hist_action[order[starts]].reshape(-1),
             reward=returns.reshape(-1).astype(np.float32),
             discount=boot.reshape(-1).astype(np.float32),
-            next_obs=next_obs.reshape(F * N, *next_obs.shape[2:]),
+            next_obs=next_obs.reshape(S * N, *next_obs.shape[2:]),
         )
         return Chunk(priorities, transitions, F * N)
 

@@ -59,6 +59,11 @@ class ActorConfig:
     gamma: float = 0.99                   # parameters.json:14
     flush_every: int = 16                 # chunk emission period (steps)
     sync_every: int = 500                 # param poll period, parameters.json:16
+    # n-step window emission: "overlapping" = every step starts a window
+    # (stride 1, the Ape-X paper's sliding window); "strided" = only
+    # n-aligned starts (stride n — reference parity: the reference's buffer
+    # advances n steps per emitted transition, reference actor.py:44-70).
+    emission: str = "overlapping"
     # Actor placement: "thread" = fleets as threads in the learner process
     # (vector/fake envs); "process" = num_workers CPU-only worker processes,
     # params over shared memory, experience over a bounded queue
@@ -148,6 +153,10 @@ class ApexConfig:
             (a.sync_every >= 1, "actor.sync_every must be >= 1"),
             (a.mode in ("thread", "process"),
              f"unknown actor.mode: {a.mode}"),
+            (a.emission in ("overlapping", "strided"),
+             f"unknown actor.emission: {a.emission}"),
+            (a.emission != "strided" or a.flush_every >= a.num_steps,
+             "actor.emission=strided requires flush_every >= num_steps"),
             (a.num_workers >= 1, "actor.num_workers must be >= 1"),
             (a.mode != "process" or a.num_actors >= a.num_workers,
              "actor.num_actors must be >= actor.num_workers in process mode"),

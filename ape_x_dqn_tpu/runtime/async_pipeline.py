@@ -152,6 +152,8 @@ class AsyncPipeline:
         prefetch_depth: int = 2,
         max_actor_restarts: int = 3,
         fused_inflight: int = 2,
+        eval_every: int = 0,
+        eval_episodes: int = 10,
     ):
         self.comps = build_components(cfg)
         self.cfg = self.comps.cfg
@@ -282,6 +284,39 @@ class AsyncPipeline:
                 rng_salt=self._proc_idx * 7919,
             )
         self.episode_returns: List[float] = []
+        # Periodic greedy evaluation (ε≈0.001, no emission — the scoring
+        # path for the "median human-normalized score" north-star metric;
+        # evaluation.py).  Runs on the learner thread at the cadence, so
+        # eval time is learner downtime; 0 disables.
+        self._eval_every = int(eval_every)
+        self._eval_episodes = int(eval_episodes)
+        self._next_eval = self._eval_every
+        self._evaluator = None
+        self.eval_scores: List[float] = []
+
+    def _maybe_eval(self):
+        if not self._eval_every or self._learner_step < self._next_eval:
+            return
+        while self._next_eval <= self._learner_step:
+            self._next_eval += self._eval_every
+        from ape_x_dqn_tpu.evaluation import log_result, make_evaluator
+
+        if self._evaluator is None:
+            self._evaluator = make_evaluator(
+                self.comps.env_fns, self.comps.network,
+                env_name=self.cfg.env.name, seed=self.cfg.seed,
+            )
+        params = (
+            self.fused.params_for_publish()
+            if self.fused is not None
+            else self._params_host(self.comps.state.params)
+        )
+        with self.timers.stage("eval"):
+            res = self._evaluator.evaluate(
+                params, episodes=self._eval_episodes
+            )
+        self.eval_scores.append(res.mean_score)
+        log_result(self.logger, res)
 
     @property
     def learner_step(self) -> int:
@@ -394,6 +429,7 @@ class AsyncPipeline:
                                 replay=self.comps.replay,
                                 replay_suffix=sfx,
                             )
+                    self._maybe_eval()
                     if self._learner_step % self.log_every == 0:
                         self._emit(metrics)
                 if pending is not None:
@@ -467,6 +503,7 @@ class AsyncPipeline:
                 if next_ckpt is not None and self._learner_step >= next_ckpt:
                     self._save_fused_checkpoint()
                     next_ckpt += cfg.learner.checkpoint_every
+                self._maybe_eval()
                 if self._learner_step >= next_log:
                     self._emit_fused(last_metrics)
                     next_log += self.log_every

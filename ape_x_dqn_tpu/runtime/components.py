@@ -166,6 +166,7 @@ class Components:
             flush_every=cfg.actor.flush_every,
             sync_every=cfg.actor.sync_every,
             seed=cfg.seed + seed_offset,
+            emission=cfg.actor.emission,
         )
 
 

@@ -306,6 +306,7 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
             seed=cfg.seed + 9000 + worker_id + 100_000 * attempt + seed_base,
             epsilon_index_offset=lo,
             epsilon_total=N,
+            emission=cfg.actor.emission,
         )
         buf = SharedParamBuffer(shm_capacity, name=shm_name, create=False)
         source = SharedBufferParamSource(buf, template)

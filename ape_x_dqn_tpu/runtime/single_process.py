@@ -56,6 +56,7 @@ class SingleProcessDriver:
                 "learner.device_replay=true runs via the async pipeline"
             )
         self.cfg = comps.cfg
+        self.comps = comps
         self.learner_steps_per_iter = learner_steps_per_iter
         self.obs_shape = comps.obs_shape
         self.num_actions = comps.num_actions

@@ -46,6 +46,16 @@ def build_argparser() -> argparse.ArgumentParser:
     )
     p.add_argument("--metrics-file", default=None, help="also write JSONL here")
     p.add_argument(
+        "--eval-every", type=int, default=0, metavar="STEPS",
+        help="greedy-evaluate (ε≈0.001, no emission) every N learner steps, "
+        "logging eval/score and — for Atari games — eval/hns (human-"
+        "normalized, evaluation.py); 0 disables",
+    )
+    p.add_argument(
+        "--eval-episodes", type=int, default=10,
+        help="episodes per evaluation pass",
+    )
+    p.add_argument(
         "--tensorboard-dir", default=None,
         help="also write scalar metrics as TensorBoard events here",
     )
@@ -111,13 +121,18 @@ def _run(args, cfg, logger) -> int:
     if args.mode == "async":
         from ape_x_dqn_tpu.runtime import AsyncPipeline
 
-        pipe = AsyncPipeline(cfg, logger=logger, log_every=args.log_every)
+        pipe = AsyncPipeline(
+            cfg, logger=logger, log_every=args.log_every,
+            eval_every=args.eval_every, eval_episodes=args.eval_episodes,
+        )
         final = pipe.run(learner_steps=args.steps)
         print("final:", final, file=sys.stderr)
     else:
         from ape_x_dqn_tpu.runtime import SingleProcessDriver
 
         driver = SingleProcessDriver(cfg)
+        evaluator = None
+        next_eval = args.eval_every
         target = args.steps if args.steps is not None else cfg.learner.total_steps
         while driver.learner_step < target:
             res = driver.run_iteration()
@@ -127,6 +142,19 @@ def _run(args, cfg, logger) -> int:
             if res.loss == res.loss:  # not NaN
                 logger.log("learner/loss", res.loss)
                 logger.log("learner/mean_q", res.mean_q)
+            if args.eval_every and driver.learner_step >= next_eval:
+                from ape_x_dqn_tpu.evaluation import log_result, make_evaluator
+
+                while next_eval <= driver.learner_step:
+                    next_eval += args.eval_every
+                if evaluator is None:
+                    evaluator = make_evaluator(
+                        driver.comps.env_fns, driver.network,
+                        env_name=cfg.env.name, seed=cfg.seed,
+                    )
+                log_result(logger, evaluator.evaluate(
+                    driver.state.params, episodes=args.eval_episodes
+                ))
             if (
                 driver.learner_step
                 and driver.learner_step % args.log_every == 0

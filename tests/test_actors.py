@@ -247,3 +247,85 @@ def test_epsilon_ladder_changes_behavior():
     assert deviation[0] > 0.4
     assert deviation[-1] < 0.1
     assert deviation[0] > deviation[-1] + 0.3
+
+
+class StepCounterEnv:
+    """Obs encodes the global step index; reward at step t is t.  Never
+    ends (long time limit) — a transparent probe for emission cadence."""
+
+    observation_shape = (2,)
+    num_actions = 2
+
+    def __init__(self):
+        self._c = 0
+
+    def _obs(self):
+        return np.asarray([self._c % 256, self._c // 256], np.uint8)
+
+    def reset(self, seed=None):
+        self._c = 0
+        return self._obs()
+
+    def step(self, action):
+        from ape_x_dqn_tpu.envs.core import StepResult
+
+        r = float(self._c)
+        self._c += 1
+        return StepResult(self._obs(), r, False, self._c >= 10_000)
+
+
+def _fleet_on_counter(emission, n_step=3, flush_every=4, num_actors=2):
+    net = DuelingMLP(num_actions=2, hidden_sizes=(8,))
+    fleet = ActorFleet(
+        [StepCounterEnv] * num_actors, net, n_step=n_step, gamma=0.5,
+        flush_every=flush_every, emission=emission,
+    )
+    params = net.init(jax.random.PRNGKey(0), np.zeros((1, 2), np.uint8))
+    fleet.sync_params(LocalParamSource(params))
+    return fleet
+
+
+def _window_starts(chunks):
+    out = []
+    for c in chunks:
+        o = c.transitions.obs.astype(np.int64)
+        out.append(o[:, 0] + 256 * o[:, 1])
+    return np.concatenate(out) if out else np.zeros(0, np.int64)
+
+
+class TestEmissionModes:
+    def test_strided_reproduces_reference_window_boundaries(self):
+        """actor.emission=strided must emit exactly the n-aligned window
+        starts 0, n, 2n, ... with no overlap and no gaps across flush
+        boundaries (the reference's advance-by-n buffer, actor.py:44-70) —
+        flush_every=4 deliberately not divisible by n=3."""
+        fleet = _fleet_on_counter("strided", n_step=3, flush_every=4)
+        chunks, _ = fleet.collect(40)
+        starts = _window_starts(chunks)
+        # Both actors share the cadence; dedupe to the schedule itself.
+        sched = np.unique(starts)
+        want = np.arange(0, sched.max() + 1, 3)
+        np.testing.assert_array_equal(sched, want)
+        # Every start appears exactly once per actor (no duplicate emission).
+        assert len(starts) == 2 * len(sched)
+        # Return math unchanged: window at start t holds t + γ(t+1) + γ²(t+2).
+        g = 0.5
+        rewards = np.concatenate([c.transitions.reward for c in chunks])
+        t = starts.astype(np.float64)
+        np.testing.assert_allclose(
+            rewards, t + g * (t + 1) + g * g * (t + 2), rtol=1e-6
+        )
+
+    def test_overlapping_emits_every_start(self):
+        fleet = _fleet_on_counter("overlapping", n_step=3, flush_every=4)
+        chunks, _ = fleet.collect(40)
+        sched = np.unique(_window_starts(chunks))
+        np.testing.assert_array_equal(sched, np.arange(sched.max() + 1))
+
+    def test_strided_requires_flush_at_least_n(self):
+        with pytest.raises(ValueError, match="flush_every >= num_steps"):
+            _fleet_on_counter("strided", n_step=3, flush_every=2)
+
+    def test_unknown_emission_rejected(self):
+        with pytest.raises(ValueError, match="unknown emission"):
+            _fleet_on_counter("sometimes")

@@ -55,14 +55,19 @@ def run_sweep(
     out_path: str | None = None,
     overrides: list[str] = (),
     seed0: int = 0,
+    eval_episodes: int = 0,
 ) -> list[dict]:
     """One training run per game under the shared schedule; returns (and
-    optionally writes) one summary record per game."""
-    from ape_x_dqn_tpu.config import load_config, to_dict
+    optionally writes) one summary record per game.  With ``eval_episodes``
+    > 0, each game ends with a greedy evaluation (evaluation.py) and the
+    final record carries the suite's MEDIAN human-normalized score — the
+    north-star headline (BASELINE.json metric)."""
+    from ape_x_dqn_tpu.config import load_config
     from ape_x_dqn_tpu.utils.metrics import MetricLogger
 
     out = open(out_path, "a") if out_path else None
     results = []
+    game_scores: dict = {}
     for i, game in enumerate(games):
         cfg = load_config(base, overrides=list(overrides))
         cfg.env.name = game
@@ -77,6 +82,12 @@ def run_sweep(
 
                 pipe = AsyncPipeline(cfg, logger=logger, log_every=10_000)
                 final = pipe.run(learner_steps=steps)
+                comps = pipe.comps
+                params = (
+                    pipe.fused.params_for_publish()
+                    if pipe.fused is not None
+                    else comps.state.params
+                )
             else:
                 from ape_x_dqn_tpu.runtime import SingleProcessDriver
 
@@ -84,12 +95,42 @@ def run_sweep(
                 iters = driver.run(learner_steps=steps)
                 final = iters[-1]._asdict() if iters else {}
                 final.pop("episodes", None)
+                comps, params = driver.comps, driver.state.params
             record.update(final=final, status="ok")
+            if eval_episodes:
+                # Own try: an eval hiccup must not re-stamp a successfully
+                # trained game as failed (it only loses its score entry).
+                try:
+                    from ape_x_dqn_tpu.evaluation import make_evaluator
+
+                    ev = make_evaluator(
+                        comps.env_fns, comps.network,
+                        env_name=game, seed=cfg.seed,
+                    ).evaluate(params, episodes=eval_episodes)
+                    record.update(eval_score=ev.mean_score, eval_hns=ev.hns)
+                    game_scores[game] = ev.mean_score
+                except Exception as e:  # noqa: BLE001
+                    record.update(eval_error=f"{type(e).__name__}: {e}")
         except Exception as e:  # noqa: BLE001 — a sweep survives bad games
             record.update(status="error", error=f"{type(e).__name__}: {e}")
         record["wall_s"] = round(time.time() - t0, 1)
         results.append(record)
         line = json.dumps(record)
+        print(line)
+        if out:
+            out.write(line + "\n")
+            out.flush()
+    if game_scores:
+        from ape_x_dqn_tpu.evaluation import median_human_normalized
+
+        summary = {
+            "summary": True,
+            "games": len(results),
+            "evaluated": len(game_scores),
+            "median_hns": median_human_normalized(game_scores),
+        }
+        results.append(summary)
+        line = json.dumps(summary)
         print(line)
         if out:
             out.write(line + "\n")
@@ -110,16 +151,19 @@ def main(argv=None) -> int:
     p.add_argument("--set", dest="overrides", action="append", default=[],
                    metavar="PATH=VALUE")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--eval-episodes", type=int, default=0,
+                   help="greedy-eval each game at the end and report the "
+                   "suite's median human-normalized score (0 = off)")
     args = p.parse_args(argv)
     results = run_sweep(
         game_list(args.games), base=args.base, steps=args.steps,
         mode=args.mode, out_path=args.out, overrides=args.overrides,
-        seed0=args.seed,
+        seed0=args.seed, eval_episodes=args.eval_episodes,
     )
-    failed = [r for r in results if r["status"] != "ok"]
-    print(f"sweep done: {len(results) - len(failed)}/{len(results)} ok",
-          file=sys.stderr)
-    return 1 if len(failed) == len(results) else 0
+    failed = [r for r in results if not r.get("summary") and r["status"] != "ok"]
+    games_n = len([r for r in results if not r.get("summary")])
+    print(f"sweep done: {games_n - len(failed)}/{games_n} ok", file=sys.stderr)
+    return 1 if len(failed) == games_n else 0
 
 
 if __name__ == "__main__":
