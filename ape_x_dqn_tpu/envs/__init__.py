@@ -5,6 +5,8 @@
   * ``"catch"``     — bsuite-style Catch (pixel learning tests)
   * ``"loop:T"``    — single-state truncation-only env (bootstrap tests)
   * ``"random"`` / ``"random:HxWxC"`` — RandomFrameEnv (throughput benches)
+  * ``"fake-atari"`` — the full DQN wrapper stack over the ALE-faithful
+    fake emulator (lives counter, sprite flicker — envs/fake_atari.py)
   * anything else   — the full Atari preprocessing stack via gymnasium
     (reference env.py:3-4's ``gym.make``, plus the wrappers it lacked).
 """
@@ -20,7 +22,9 @@ from ape_x_dqn_tpu.envs.atari import (
     RewardClip,
     make_atari_env,
     make_local_env,
+    wrap_dqn,
 )
+from ape_x_dqn_tpu.envs.fake_atari import FakeAtariEnv, make_fake_atari_env
 from ape_x_dqn_tpu.envs.core import (
     CatchEnv,
     ChainMDP,
@@ -48,6 +52,13 @@ def make_env(spec: str, seed: int = 0, **atari_kwargs) -> Env:
         else:
             dims = (84, 84, 1)
         return RandomFrameEnv(obs_shape=dims, seed=seed)
+    if spec == "fake-atari":
+        # The full DQN wrapper stack over the ALE-faithful fake emulator
+        # (envs/fake_atari.py) — end-to-end Atari-shaped training without
+        # ALE installed.
+        from ape_x_dqn_tpu.envs.fake_atari import make_fake_atari_env
+
+        return make_fake_atari_env(**atari_kwargs)
     return make_atari_env(spec, **atari_kwargs)
 
 
@@ -56,6 +67,7 @@ __all__ = [
     "ChainMDP",
     "Env",
     "EpisodicLife",
+    "FakeAtariEnv",
     "LoopEnv",
     "FrameSkip",
     "FrameStack",
@@ -68,5 +80,7 @@ __all__ = [
     "VectorStep",
     "make_atari_env",
     "make_env",
+    "make_fake_atari_env",
     "make_local_env",
+    "wrap_dqn",
 ]

@@ -195,6 +195,30 @@ class EpisodicLife:
         return r._replace(terminated=terminated)
 
 
+def wrap_dqn(
+    env: Env,
+    frame_skip: int = 4,
+    frame_stack: int = 1,
+    episodic_life: bool = True,
+    clip_rewards: bool = True,
+    height: int = 84,
+    width: int = 84,
+) -> Env:
+    """The DQN wrapper stack over ANY raw-frame env — the one ordering
+    shared by the real Atari factory below and the ALE-faithful fake
+    (envs/fake_atari.py), so tests drive the exact production stack."""
+    if episodic_life:
+        env = EpisodicLife(env)
+    if frame_skip > 1:
+        env = FrameSkip(env, frame_skip)
+    env = ObsPreprocess(env, height, width)
+    if frame_stack > 1:
+        env = FrameStack(env, frame_stack)
+    if clip_rewards:
+        env = RewardClip(env)
+    return env
+
+
 def make_atari_env(
     env_name: str,
     frame_skip: int = 4,
@@ -207,14 +231,12 @@ def make_atari_env(
     """The full DQN Atari stack.  ``frame_stack=1`` is reference parity
     (single grayscale frame, parameters.json:3); 4 is the Nature/Ape-X
     setting."""
-    env = make_local_env(env_name)
-    if episodic_life:
-        env = EpisodicLife(env)
-    if frame_skip > 1:
-        env = FrameSkip(env, frame_skip)
-    env = ObsPreprocess(env, height, width)
-    if frame_stack > 1:
-        env = FrameStack(env, frame_stack)
-    if clip_rewards:
-        env = RewardClip(env)
-    return env
+    return wrap_dqn(
+        make_local_env(env_name),
+        frame_skip=frame_skip,
+        frame_stack=frame_stack,
+        episodic_life=episodic_life,
+        clip_rewards=clip_rewards,
+        height=height,
+        width=width,
+    )

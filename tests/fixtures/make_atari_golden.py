@@ -1,0 +1,64 @@
+"""Regenerate atari_golden.npz — the ObsPreprocess golden fixture.
+
+Inputs are deterministic synthetic RGB frames (gradients + blocks, no RNG);
+expected outputs are pinned from the cv2 luminance + INTER_AREA path at
+generation time.  The fixture exists to catch silent behavior drift (cv2
+version changes, preprocessing edits); regenerate ONLY on an intended
+preprocessing change:
+
+    python tests/fixtures/make_atari_golden.py
+"""
+
+import os
+
+import numpy as np
+
+
+def make_frames():
+    frames = []
+    # Diagonal gradient (full 210x160 ALE geometry).
+    r = (np.arange(210)[:, None] + np.zeros((1, 160))) % 256
+    g = (np.zeros((210, 1)) + np.arange(160)[None, :]) % 256
+    b = (np.arange(210)[:, None] + np.arange(160)[None, :]) % 256
+    frames.append(np.stack([r, g, b], axis=-1).astype(np.uint8))
+    # Blocks + bright sprite on dark background.
+    f = np.zeros((210, 160, 3), np.uint8)
+    f[20:60, 30:90] = (200, 30, 120)
+    f[100:116, 40:56] = 255
+    f[150:, :, 1] = 90
+    frames.append(f)
+    return frames
+
+
+def main():
+    from ape_x_dqn_tpu.envs.core import StepResult  # noqa: F401 (import check)
+    from ape_x_dqn_tpu.envs.atari import ObsPreprocess
+
+    class _One:
+        observation_shape = (210, 160, 3)
+        num_actions = 1
+
+        def __init__(self, frame):
+            self._frame = frame
+
+        def reset(self, seed=None):
+            return self._frame
+
+        def step(self, action):
+            raise NotImplementedError
+
+    frames = make_frames()
+    outs = [
+        ObsPreprocess(_One(f), 84, 84).reset() for f in frames
+    ]
+    path = os.path.join(os.path.dirname(__file__), "atari_golden.npz")
+    np.savez_compressed(
+        path,
+        **{f"in_{i}": f for i, f in enumerate(frames)},
+        **{f"out_{i}": o for i, o in enumerate(outs)},
+    )
+    print(f"wrote {path}: {len(frames)} frame pairs")
+
+
+if __name__ == "__main__":
+    main()

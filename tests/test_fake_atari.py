@@ -1,0 +1,172 @@
+"""Atari-stack hardening without ALE (round-3 verdict item 6): golden
+preprocessing fixtures + the ALE-faithful fake emulator driving
+EpisodicLife / FrameSkip / RewardClip's exact branch structure."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.envs.atari import (
+    EpisodicLife,
+    FrameSkip,
+    ObsPreprocess,
+    RewardClip,
+    wrap_dqn,
+)
+from ape_x_dqn_tpu.envs.fake_atari import FakeAtariEnv, make_fake_atari_env
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+class _OneFrame:
+    observation_shape = (210, 160, 3)
+    num_actions = 1
+
+    def __init__(self, frame):
+        self._frame = frame
+
+    def reset(self, seed=None):
+        return self._frame
+
+    def step(self, action):
+        raise NotImplementedError
+
+
+class TestObsPreprocessGolden:
+    def test_matches_committed_fixture(self):
+        """Byte-exact against the committed golden outputs — catches silent
+        drift in the cv2 luminance/resize path (regenerate via
+        tests/fixtures/make_atari_golden.py only on INTENDED changes)."""
+        with np.load(os.path.join(FIXTURES, "atari_golden.npz")) as z:
+            i = 0
+            while f"in_{i}" in z.files:
+                got = ObsPreprocess(_OneFrame(z[f"in_{i}"]), 84, 84).reset()
+                np.testing.assert_array_equal(got, z[f"out_{i}"])
+                i += 1
+        assert i >= 2
+
+    def test_constant_frame_analytic_luminance(self):
+        """Independent of cv2 versions: a constant-color frame maps to the
+        ITU-R 601 luminance (0.299R+0.587G+0.114B) everywhere — resizing a
+        constant image is the constant."""
+        frame = np.zeros((210, 160, 3), np.uint8)
+        frame[:] = (100, 150, 200)
+        out = ObsPreprocess(_OneFrame(frame), 84, 84).reset()
+        want = 0.299 * 100 + 0.587 * 150 + 0.114 * 200  # 140.75
+        assert out.shape == (84, 84, 1)
+        assert np.all(np.abs(out.astype(np.float64) - want) <= 1.0)
+
+
+class TestFakeALEStack:
+    def test_flicker_repaired_by_frameskip_maxpool(self):
+        """The sprite renders only on even raw frames; FrameSkip's 2-frame
+        max-pool must restore it in EVERY pooled observation."""
+        raw = FakeAtariEnv(lives=99, steps_per_life=10_000)
+        raw.reset()
+        # Raw odd frames lack the sprite (value-255 pixels).
+        odd = raw.step(0).obs   # t=1
+        assert not (odd == 255).any()
+        even = raw.step(0).obs  # t=2
+        assert (even == 255).any()
+
+        env = FrameSkip(FakeAtariEnv(lives=99, steps_per_life=10_000), 4)
+        env.reset()
+        for _ in range(10):
+            r = env.step(0)
+            assert (r.obs == 255).any(), "flicker leaked through max-pool"
+
+    def test_episodic_life_terminates_per_life_without_reset(self):
+        """A life loss must surface terminated=True to the learner while
+        the underlying game continues (no emulator reset) — the corner
+        pixel's step index proves frame continuity."""
+        inner = FakeAtariEnv(lives=3, steps_per_life=5)
+        env = EpisodicLife(inner)
+        env.reset()
+        resets_before = inner.full_resets
+        # Steps 1..5: the 5th loses a life -> wrapper terminal.
+        flags = [env.step(0).terminated for _ in range(5)]
+        assert flags == [False] * 4 + [True]
+        # Learner-side reset: no real reset; the no-op step advances t.
+        obs = env.reset()
+        assert inner.full_resets == resets_before
+        assert obs[0, 0, 0] == 6  # t continued past the death frame
+        # Second life plays out the same way.
+        flags = [env.step(0).terminated for _ in range(4)]
+        assert flags == [False] * 3 + [True]  # t=10: second life lost
+
+    def test_episodic_life_full_reset_on_game_over(self):
+        inner = FakeAtariEnv(lives=2, steps_per_life=3)
+        env = EpisodicLife(inner)
+        env.reset()
+        resets_before = inner.full_resets
+        # Life 1 lost at t=3 (wrapper terminal), life 2 (final) at t=6 —
+        # the env itself terminates; the next reset must be real.
+        for _ in range(3):
+            r = env.step(0)
+        assert r.terminated
+        env.reset()  # fake (no-op) reset
+        for _ in range(2):
+            r = env.step(0)
+        assert r.terminated  # t=6: game over
+        obs = env.reset()
+        assert inner.full_resets == resets_before + 1
+        assert obs[0, 0, 0] == 0  # t restarted
+
+    def test_no_op_reset_hitting_game_over_falls_through(self):
+        """EpisodicLife's subtle branch: when the post-death no-op step
+        itself ends the game, reset must fall through to a REAL reset so
+        no episode starts on a game-over frame."""
+        # steps_per_life=1: every step loses a life; 2 lives total.
+        inner = FakeAtariEnv(lives=2, steps_per_life=1)
+        env = EpisodicLife(inner)
+        env.reset()
+        r = env.step(0)   # t=1: life 1 lost -> wrapper terminal, game alive
+        assert r.terminated
+        resets_before = inner.full_resets
+        obs = env.reset()  # no-op step at t=2 loses the LAST life
+        assert inner.full_resets == resets_before + 1
+        assert obs[0, 0, 0] == 0
+
+    def test_reward_clip_on_unclipped_rewards(self):
+        env = RewardClip(FakeAtariEnv(lives=9, steps_per_life=10_000,
+                                      reward_every=2, reward=7.0))
+        env.reset()
+        rewards = [env.step(0).reward for _ in range(6)]
+        assert rewards == [0.0, 1.0, 0.0, 1.0, 0.0, 1.0]
+
+    def test_full_stack_shapes_and_factory(self):
+        from ape_x_dqn_tpu.envs import make_env
+
+        env = make_env("fake-atari", frame_skip=4, frame_stack=4)
+        assert env.observation_shape == (84, 84, 4)
+        obs = env.reset()
+        assert obs.shape == (84, 84, 4) and obs.dtype == np.uint8
+        r = env.step(1)
+        assert r.obs.shape == (84, 84, 4)
+        assert -1.0 <= r.reward <= 1.0
+
+    def test_full_stack_trains_end_to_end(self):
+        """The flagship conv path on the fake-ALE stack: actors roll real
+        84×84 frames through EpisodicLife+FrameSkip+preprocess and the
+        learner trains — Atari-shaped end-to-end without ALE."""
+        from ape_x_dqn_tpu.config import ApexConfig
+        from ape_x_dqn_tpu.runtime import SingleProcessDriver
+
+        cfg = ApexConfig()
+        cfg.env.name = "fake-atari"
+        cfg.network = "conv"
+        cfg.actor.num_actors = 2
+        cfg.actor.flush_every = 8
+        cfg.learner.min_replay_mem_size = 64
+        cfg.learner.replay_sample_size = 16
+        cfg.learner.optimizer = "adam"
+        cfg.replay.capacity = 1024
+        cfg.validate()
+        driver = SingleProcessDriver(cfg)
+        results = driver.run(learner_steps=3)
+        losses = [r.loss for r in results if np.isfinite(r.loss)]
+        assert losses, "no learner steps ran"
+        assert all(np.isfinite(l) for l in losses)
+        batch = driver.replay.sample(8, rng=np.random.default_rng(0))
+        assert batch.transition.obs.shape[1:] == (84, 84, 1)
