@@ -71,6 +71,12 @@ class ActorConfig:
     # main.py:50-54, rebuilt on the TPU transport stack).
     mode: str = "thread"
     num_workers: int = 2                  # worker processes (mode="process")
+    # Unix niceness applied inside each worker process (mode="process").
+    # On hosts where workers share cores with the learner process, raising
+    # this keeps the learner's dispatch thread scheduled ahead of worker
+    # CPU inference (measured on a 1-core VM: nice-0 workers starve the
+    # fused learner ~7x below its solo rate).  0 = scheduler default.
+    worker_nice: int = 0
 
 
 @dataclasses.dataclass
@@ -104,6 +110,12 @@ class LearnerConfig:
     # data_parallel == 0 in the fused mode).
     data_parallel: int = 1
     steps_per_call: int = 128             # K steps fused per dispatch
+    # Fused-mode ingest granularity (rows per compiled device add).  Each
+    # block is one host->device dispatch; on high-latency links (the
+    # tunneled bench platform: ~35 ms/dispatch) bigger blocks cut ingest
+    # stalls on the learner thread.  Must divide by data_parallel in the
+    # sharded fused mode.
+    ingest_block: int = 256
     # HBM-traffic knobs ("bfloat16" | None): reduced-precision RMSProp
     # second moment and target net — see make_optimizer / init_train_state.
     second_moment_dtype: Optional[str] = None
@@ -158,6 +170,8 @@ class ApexConfig:
             (a.emission != "strided" or a.flush_every >= a.num_steps,
              "actor.emission=strided requires flush_every >= num_steps"),
             (a.num_workers >= 1, "actor.num_workers must be >= 1"),
+            (0 <= a.worker_nice <= 19,
+             "actor.worker_nice must be in [0, 19]"),
             (a.mode != "process" or a.num_actors >= a.num_workers,
              "actor.num_actors must be >= actor.num_workers in process mode"),
             (l.publish_every >= 1, "learner.publish_every must be >= 1"),
@@ -176,6 +190,11 @@ class ApexConfig:
              f"unknown optimizer kind: {l.optimizer}"),
             (l.loss in ("huber", "squared"), f"unknown loss kind: {l.loss}"),
             (l.steps_per_call >= 1, "learner.steps_per_call must be >= 1"),
+            (l.ingest_block >= 1, "learner.ingest_block must be >= 1"),
+            (not (l.device_replay and l.data_parallel > 1)
+             or l.ingest_block % l.data_parallel == 0,
+             "learner.ingest_block must be divisible by data_parallel "
+             "when device_replay=True"),
             (l.data_parallel >= 1, "learner.data_parallel must be >= 1"),
             (l.replay_sample_size % l.data_parallel == 0,
              "learner.replay_sample_size must be divisible by data_parallel"),

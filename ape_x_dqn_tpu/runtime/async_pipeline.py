@@ -46,6 +46,76 @@ from ape_x_dqn_tpu.utils.metrics import MetricLogger, RateCounter
 from ape_x_dqn_tpu.utils.profiling import StageTimer
 
 
+class _AsyncPublisher:
+    """Publish param snapshots off the learner thread.
+
+    A publish = device_get (~13 MB through the tunnel) + wire serialization
+    + checksum + shared-memory write — tens of ms on a free core, but
+    SECONDS when worker processes contend for the host (measured 17-43 s
+    per publish on the 1-core bench VM).  The learner thread only snapshots
+    the params with a cheap device-side copy (one tiny dispatch, no sync)
+    and hands the copy here; this thread does the slow host work.  A 1-slot
+    latest-wins mailbox: if publishing lags, intermediate versions are
+    skipped — exactly the versioned-snapshot semantics the store already
+    has (actors always want the newest, reference actor.py:189-191).
+    """
+
+    def __init__(self, store):
+        self._store = store
+        self._pending = None
+        self._busy = False
+        self._cv = threading.Condition()
+        self._stop = False
+        self.error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._loop, name="param-publisher", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, device_params) -> None:
+        with self._cv:
+            self._pending = device_params  # latest wins
+            self._cv.notify()
+
+    def flush(self, timeout: float = 120.0) -> bool:
+        """Block until the newest submitted snapshot has been published.
+        Returns False if work is still outstanding at the timeout — the
+        caller must surface that (a silently unpublished final snapshot
+        leaves actors on stale params with no error)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while (self._pending is not None or self._busy) \
+                    and time.monotonic() < deadline:
+                self._cv.wait(timeout=0.1)
+            return self._pending is None and not self._busy
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=30.0)
+
+    def _loop(self) -> None:
+        import jax
+
+        while True:
+            with self._cv:
+                while self._pending is None and not self._stop:
+                    self._cv.wait()
+                if self._pending is None and self._stop:
+                    return
+                params, self._pending = self._pending, None
+                self._busy = True
+            try:
+                self._store.publish(jax.device_get(params))
+            except BaseException as e:  # noqa: BLE001 — surfaced by runtime
+                self.error = e
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+
 class _ActorWorker:
     """Supervised actor-fleet thread with respawn-on-crash."""
 
@@ -174,7 +244,19 @@ class AsyncPipeline:
         # drops ~30x).  Capping in-flight fused calls to ``fused_inflight``
         # (forcing call i-1's metrics to host before dispatching i+1)
         # bounds actor latency to ~one fused call.
+        #
+        # Drain policy: in THREAD mode, pop ONE per call (steady fairness —
+        # actors interleave between fused calls).  In PROCESS mode no actor
+        # touches the device, so the queue fills to the cap and drains ALL
+        # at once: on this tunneled platform every host sync charges
+        # ~140-240 ms to the next dispatch, so one sync burst per
+        # ``fused_inflight`` calls amortizes that penalty instead of paying
+        # it per call (measured: per-call forcing caps the process-mode
+        # learner ~3x below its solo rate).
         self._fused_inflight = max(1, int(fused_inflight))
+        self._fused_drain_all = cfg.actor.mode == "process"
+        if self._fused_drain_all:
+            self._fused_inflight = max(self._fused_inflight, 8)
         self.fused = None
         self.mesh = None
         # SPMD process identity (multi-host; 1/0 when jax.distributed was
@@ -272,6 +354,20 @@ class AsyncPipeline:
                 self._fps, max_restarts=max_actor_restarts, sink=sink,
                 seed_base=self._proc_idx * 7919,
             )
+        # Off-thread publisher (single-process): the learner snapshots
+        # params with one cheap device-side copy; device_get + serialize +
+        # store write happen on the publisher thread (see _AsyncPublisher —
+        # measured seconds per publish under worker CPU contention).
+        # Multi-host keeps the synchronous per-leaf local-replica path.
+        self._publisher = None
+        self._param_copy = None
+        if self._n_proc == 1:
+            import jax.numpy as jnp
+
+            self._param_copy = jax.jit(
+                lambda t: jax.tree_util.tree_map(jnp.copy, t)
+            )
+            self._publisher = _AsyncPublisher(self.store)
         self._learner_step = self.comps.learner_step
         if self.fused is not None:
             self._sample = None
@@ -317,6 +413,38 @@ class AsyncPipeline:
             )
         self.eval_scores.append(res.mean_score)
         log_result(self.logger, res)
+
+    def _publish(self, params) -> None:
+        if self._publisher is not None:
+            # Surface publisher failures at the NEXT publish, not hours
+            # later at end-of-run (actors would train against frozen
+            # version-0 params the whole time).
+            if self._publisher.error is not None:
+                raise RuntimeError(
+                    "param publisher failed"
+                ) from self._publisher.error
+            self._publisher.submit(self._param_copy(params))
+        else:
+            self.store.publish(self._params_host(params))
+
+    def _finish_publishes(self) -> None:
+        if self._publisher is not None:
+            flushed = self._publisher.flush()
+            if self._publisher.error is not None:
+                raise RuntimeError(
+                    "param publisher failed"
+                ) from self._publisher.error
+            if not flushed:
+                raise RuntimeError(
+                    "param publisher could not drain within its timeout — "
+                    "the final snapshot was never published"
+                )
+
+    def _force_fused(self, metrics) -> None:
+        """Force one fused call's completion (tiny host read — see bench.py
+        methodology) and credit its steps to the completion-time rate."""
+        float(np.asarray(metrics.loss[-1]))
+        self._steps_rate.add(self.fused.steps_per_call)
 
     @property
     def learner_step(self) -> int:
@@ -390,7 +518,7 @@ class AsyncPipeline:
                     pending = (host_indices, metrics.priorities)
                     if self._learner_step % cfg.learner.publish_every == 0:
                         with self.timers.stage("publish"):
-                            self.store.publish(self._params_host(state.params))
+                            self._publish(state.params)
                     if (
                         cfg.learner.checkpoint_every
                         and self._learner_step % cfg.learner.checkpoint_every == 0
@@ -436,9 +564,12 @@ class AsyncPipeline:
                     self.comps.replay.update_priorities(
                         pending[0], self._priorities_host(pending[1])
                     )
+            self._finish_publishes()
         finally:
             self.stop_event.set()
             self.worker.join()
+            if self._publisher is not None:
+                self._publisher.close()
         if self.worker.error is not None:
             raise RuntimeError("actor worker died") from self.worker.error
         # Final emit carries the last step's metrics (one host sync) so the
@@ -484,13 +615,22 @@ class AsyncPipeline:
                     last_metrics = fused.train(beta)
                 inflight.append(last_metrics)
                 if len(inflight) >= self._fused_inflight:
-                    # Force the oldest call's completion with one tiny host
-                    # read (block_until_ready is a no-op on tunneled
-                    # platforms — see bench.py methodology note).
+                    # Force completion with a tiny host read
+                    # (block_until_ready is a no-op on tunneled platforms —
+                    # see bench.py methodology note).  Thread mode: oldest
+                    # only; process mode: drain the whole queue in one sync
+                    # burst (see __init__'s drain-policy comment).
+                    # steps_per_sec counts steps at FORCE time — dispatch
+                    # runs ahead of the device under deep queues, so
+                    # counting at dispatch would report bursts that haven't
+                    # executed yet.
                     with self.timers.stage("force_oldest"):
-                        float(np.asarray(inflight.pop(0).loss[-1]))
+                        if self._fused_drain_all:
+                            while inflight:
+                                self._force_fused(inflight.pop(0))
+                        else:
+                            self._force_fused(inflight.pop(0))
                 self._learner_step += fused.steps_per_call
-                self._steps_rate.add(fused.steps_per_call)
                 self.comps.state = fused.state
                 # Publish at most once per fused call — the cap
                 # (publish_every) is finer than K, so every call qualifies;
@@ -499,7 +639,7 @@ class AsyncPipeline:
                     cfg.learner.publish_every, fused.steps_per_call
                 ) < fused.steps_per_call:
                     with self.timers.stage("publish"):
-                        self.store.publish(fused.params_for_publish())
+                        self._publish(fused.params_for_publish())
                 if next_ckpt is not None and self._learner_step >= next_ckpt:
                     self._save_fused_checkpoint()
                     next_ckpt += cfg.learner.checkpoint_every
@@ -507,9 +647,16 @@ class AsyncPipeline:
                 if self._learner_step >= next_log:
                     self._emit_fused(last_metrics)
                     next_log += self.log_every
+            # Drain stragglers so the final rates/loss reflect completed
+            # device work, not dispatched-but-unfinished calls.
+            while inflight:
+                self._force_fused(inflight.pop(0))
+            self._finish_publishes()
         finally:
             self.stop_event.set()
             self.worker.join()
+            if self._publisher is not None:
+                self._publisher.close()
         if self.worker.error is not None:
             raise RuntimeError("actor worker died") from self.worker.error
         if last_metrics is not None:

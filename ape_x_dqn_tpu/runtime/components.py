@@ -144,6 +144,7 @@ class Components:
             capacity=cfg.replay.capacity,
             batch_size=cfg.learner.replay_sample_size,
             steps_per_call=K,
+            ingest_block=cfg.learner.ingest_block,
             priority_exponent=cfg.replay.priority_exponent,
             target_sync_freq=freq,
             loss_kind=cfg.learner.loss,

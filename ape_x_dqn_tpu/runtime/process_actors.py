@@ -263,9 +263,17 @@ def network_and_template(cfg):
 def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                  shm_name: str, shm_capacity: int, xp_queue, stop_evt,
                  steps_budget: int, quantum: int, attempt: int = 0,
-                 seed_base: int = 0):
+                 seed_base: int = 0, nice: int = 0):
     """Worker process entry: CPU-only jax, one ActorFleet slice, pump
     chunks + episode stats into the experience queue."""
+    if nice:
+        # QoS: on hosts where workers share cores with the learner, a
+        # positive niceness keeps the learner's dispatch thread scheduled
+        # first (actor.worker_nice).
+        try:
+            os.nice(int(nice))
+        except OSError:
+            pass
     os.environ["JAX_PLATFORMS"] = "cpu"  # before the first jax import
     # Don't inherit the test harness's virtual-device forcing: 8 fake CPU
     # devices per worker only slow the fleet's single-device jit down.
@@ -409,7 +417,8 @@ class ProcessActorPool:
             target=_worker_main,
             args=(wid, self._cfg_dict, self.num_workers, self.buffer.name,
                   self.buffer.capacity, self.queue, self.stop_event,
-                  budget, self._quantum, attempt, self._seed_base),
+                  budget, self._quantum, attempt, self._seed_base,
+                  self.cfg.actor.worker_nice),
             daemon=True,
         )
         p.start()

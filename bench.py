@@ -108,11 +108,23 @@ def _validate_samplers(rng) -> dict:
 
 
 def _pipeline_bench(learner_steps: int = 20_000, steps_per_call: int = 1024,
-                    publish_every: int = 4000, num_actors: int = 512) -> dict:
-    """End-to-end async pipeline on the real chip (VERDICT r2 item 2): actor
-    threads stepping RandomFrameEnv fleets + device infeed + the fused HBM
-    learner, all contending for the one device — reports BOTH north-star
-    metrics (learner steps/s AND actor FPS) from the same run."""
+                    publish_every: int = 4000, num_actors: int = 512,
+                    actor_mode: str = "thread", num_workers: int = 4,
+                    min_replay: int = 20_000, worker_nice: int = 10,
+                    ingest_block: int = 2048) -> dict:
+    """End-to-end async pipeline on the real chip (VERDICT r2 item 2): actors
+    + device infeed + the fused HBM learner — reports BOTH north-star
+    metrics (learner steps/s AND actor FPS) from the same run.
+
+    ``actor_mode="thread"`` puts the actor fleet's batched policy forwards
+    on the TPU, CONTENDING with the learner for the one device queue (the
+    round-3 result: every host sync charges ~140-240 ms to the next
+    dispatch, so the two stages serialize).  ``actor_mode="process"`` is
+    the designed mitigation (round-3 verdict item 2): worker processes do
+    CPU-only inference (runtime/process_actors.py), the learner owns the
+    device alone, and learner steps/s should recover toward the solo
+    figure — actor FPS is then bounded by host cores (this driver VM has
+    ONE), not the framework."""
     from ape_x_dqn_tpu.config import ApexConfig
     from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
     from ape_x_dqn_tpu.utils.metrics import MetricLogger
@@ -124,7 +136,16 @@ def _pipeline_bench(learner_steps: int = 20_000, steps_per_call: int = 1024,
     cfg.actor.T = 10_000_000
     cfg.actor.flush_every = 16
     cfg.actor.sync_every = 500
+    cfg.actor.mode = actor_mode
+    cfg.actor.num_workers = num_workers
+    # Keep the learner's dispatch thread scheduled ahead of worker CPU
+    # inference — this driver VM has one core (see actor.worker_nice).
+    cfg.actor.worker_nice = worker_nice
     cfg.learner.device_replay = True
+    if actor_mode == "process":
+        # Fewer, larger host->device ingest dispatches (~35 ms each
+        # through this tunnel).
+        cfg.learner.ingest_block = ingest_block
     cfg.learner.sample_ahead = True
     cfg.learner.steps_per_call = steps_per_call
     # Publish cadence: each publish is a full param device_get through the
@@ -132,7 +153,7 @@ def _pipeline_bench(learner_steps: int = 20_000, steps_per_call: int = 1024,
     # per-step-minded default (10) it would fire once per fused call and
     # dominate the learner's wall clock.
     cfg.learner.publish_every = publish_every
-    cfg.learner.min_replay_mem_size = 20_000
+    cfg.learner.min_replay_mem_size = min_replay
     cfg.learner.optimizer = "rmsprop"
     cfg.learner.max_grad_norm = None
     cfg.learner.second_moment_dtype = "bfloat16"
@@ -159,11 +180,14 @@ def _pipeline_bench(learner_steps: int = 20_000, steps_per_call: int = 1024,
         "window_actor_fps": result["actor_fps"],
         "config": {
             "num_actors": cfg.actor.num_actors,
+            "actor_mode": actor_mode,
+            "num_workers": num_workers if actor_mode == "process" else None,
             "env": cfg.env.name,
             "steps_per_call": cfg.learner.steps_per_call,
             "publish_every": cfg.learner.publish_every,
+            "min_replay": min_replay,
             "note": (
-                "whole-run averages incl. warmup-to-20k and compiles; "
+                "whole-run averages incl. warmup and compiles; "
                 "window_* are the final 30s sliding-window rates "
                 "(the steady-state numbers)"
             ),
@@ -412,6 +436,41 @@ def main() -> None:
             "every host sync charges ~140 ms to the next dispatch on this "
             "tunneled platform, so concurrent actor+learner dispatch "
             "cannot interleave at us granularity; see PROFILE.md"
+        )
+        # The designed mitigation, chip-benchmarked (round-3 verdict item
+        # 2): CPU-only worker-process actors leave the device to the
+        # learner alone.  Learner steps/s should recover toward the solo
+        # fused figure; actor FPS is host-core-bound (ONE core on this
+        # driver VM — real deployments put workers on their own cores).
+        # Two load points tell the story on this ONE-core driver VM: under
+        # full worker load the learner's host dispatch thread is CPU-bound
+        # against worker inference (a host-provisioning limit); with a
+        # light fleet it recovers most of the solo rate — the device is the
+        # learner's alone in both (that was the contention being fixed).
+        extra["pipeline_process"] = _pipeline_bench(
+            32_768,
+            steps_per_call=2048,
+            actor_mode="process",
+            num_workers=4,
+            num_actors=256,
+            min_replay=10_000,
+        )
+        extra["pipeline_process_light"] = _pipeline_bench(
+            63_488,
+            steps_per_call=2048,
+            publish_every=16_384,
+            actor_mode="process",
+            num_workers=1,
+            num_actors=8,
+            min_replay=2_000,
+            worker_nice=19,
+        )
+        extra["pipeline_process"]["note"] = (
+            "4 CPU-inference workers × 64 actors each on a 1-core host: "
+            "learner host thread contends with worker inference for the "
+            "core (the device itself is uncontended — that is what process "
+            "mode fixes); see pipeline_process_light for the same runtime "
+            "under light worker load"
         )
 
     print(

@@ -136,8 +136,11 @@ class TestEndToEnd:
         pool = pipe.worker.pool
         assert result["step"] >= 200
         assert result["actor_steps"] > 0
-        # Both workers contributed experience.
-        assert set(pool.last_versions) == {0, 1}
+        # Experience flowed from worker processes.  (Both-workers coverage
+        # lives in test_both_workers_deliver_chunks — with the off-thread
+        # publisher the learner can finish 200 steps before the slower
+        # worker's first chunk lands, so requiring both HERE is a race.)
+        assert set(pool.last_versions) <= {0, 1} and pool.last_versions
         # Param-version propagation: chunks arriving late in the run carry a
         # version beyond the initial publish — workers really did re-pull
         # through the shared-memory store.
@@ -146,6 +149,39 @@ class TestEndToEnd:
         assert not pool.worker_errors
         # Learner actually trained on the workers' experience.
         assert np.isfinite(result.get("learner/loss", 0.0))
+
+
+class TestBothWorkers:
+    def test_both_workers_deliver_chunks(self):
+        """Every worker owns a slice of the global actor set and must feed
+        experience — polled at pool level (no learner-step race)."""
+        from ape_x_dqn_tpu.runtime.process_actors import (
+            ProcessActorPool,
+            network_and_template,
+        )
+
+        cfg = ApexConfig()
+        cfg.network = "mlp"
+        cfg.env.name = "chain:6"
+        cfg.actor.mode = "process"
+        cfg.actor.num_workers = 2
+        cfg.actor.num_actors = 4
+        cfg.actor.T = 100_000
+        cfg.actor.flush_every = 8
+        cfg.validate()
+        pool = ProcessActorPool(cfg, num_workers=2, quantum=8)
+        try:
+            _, _, template = network_and_template(cfg)
+            pool.publish(template)
+            pool.start()
+            deadline = time.monotonic() + 180.0
+            while set(pool.last_versions) != {0, 1} \
+                    and time.monotonic() < deadline:
+                pool.poll(max_items=64, timeout=0.05)
+            assert set(pool.last_versions) == {0, 1}
+            assert not pool.worker_errors
+        finally:
+            pool.stop()
 
 
 class TestBudgetAccounting:

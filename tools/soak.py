@@ -1,0 +1,197 @@
+"""Hour-scale elasticity soak — process-mode training under random SIGKILLs.
+
+Round-3 verdict item 8 / SURVEY §5 failure detection (the reference's story
+is "actor crash = silent loss of that actor"): run the async fused pipeline
+with process-mode actors for ``--minutes``, SIGKILL a random worker every
+``--kill-every`` seconds, and assert at the end that
+
+  * the learner's step counter advanced monotonically the whole time,
+  * every kill was followed by a supervisor respawn (restarts ≥ kills,
+    within the configured budget),
+  * a final resume-from-checkpoint continues from the saved step with the
+    replay intact.
+
+Writes a JSONL heartbeat stream (one record every ``--sample-every``
+seconds: learner step, actor steps, restarts, replay size) plus a final
+summary record — the committed soak artifact.
+
+    python tools/soak.py --minutes 35 --kill-every 150 \
+        --out demos/soak_metrics.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_cfg(ckpt_dir: str, resume: bool = False):
+    from ape_x_dqn_tpu.config import ApexConfig
+
+    cfg = ApexConfig()
+    cfg.env.name = "fake-atari"          # real 84×84 conv frames, no ALE
+    cfg.network = "conv"
+    cfg.actor.num_actors = 32
+    cfg.actor.T = 1_000_000_000
+    cfg.actor.flush_every = 16
+    cfg.actor.sync_every = 200
+    cfg.actor.mode = "process"
+    cfg.actor.num_workers = 2
+    cfg.actor.worker_nice = 5
+    cfg.learner.device_replay = True
+    cfg.learner.sample_ahead = True
+    cfg.learner.steps_per_call = 512
+    cfg.learner.publish_every = 2048
+    cfg.learner.min_replay_mem_size = 2_000
+    cfg.learner.optimizer = "rmsprop"
+    cfg.learner.max_grad_norm = None
+    cfg.learner.second_moment_dtype = "bfloat16"
+    cfg.learner.target_dtype = "bfloat16"
+    cfg.learner.total_steps = 1_000_000_000
+    cfg.learner.checkpoint_every = 8192
+    cfg.learner.checkpoint_dir = ckpt_dir
+    cfg.learner.restore_from = ckpt_dir if resume else False
+    cfg.replay.capacity = 50_000
+    return cfg.validate()
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--minutes", type=float, default=35.0)
+    p.add_argument("--kill-every", type=float, default=150.0,
+                   help="seconds between randomized worker SIGKILLs")
+    p.add_argument("--sample-every", type=float, default=15.0)
+    p.add_argument("--out", default="demos/soak_metrics.jsonl")
+    p.add_argument("--ckpt-dir", default="/tmp/soak_ckpt")
+    p.add_argument("--max-restarts", type=int, default=1000)
+    args = p.parse_args()
+
+    from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
+    from ape_x_dqn_tpu.utils.metrics import MetricLogger
+
+    import shutil
+
+    shutil.rmtree(args.ckpt_dir, ignore_errors=True)
+    cfg = build_cfg(args.ckpt_dir)
+    devnull = open(os.devnull, "w")
+    pipe = AsyncPipeline(cfg, logger=MetricLogger(stream=devnull),
+                         log_every=10**9)
+    pipe.worker.pool.max_restarts = args.max_restarts
+
+    run_err = []
+
+    def run():
+        try:
+            pipe.run(learner_steps=10**12, warmup_timeout=600.0)
+        except Exception as e:  # noqa: BLE001 — surfaced in the summary
+            run_err.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+
+    out = open(args.out, "w")
+    t0 = time.time()
+    deadline = t0 + args.minutes * 60.0
+    next_kill = t0 + args.kill_every
+    next_sample = t0
+    kills = 0
+    steps_seen = []
+    rng = random.Random(0)
+    ok_monotone = True
+    while time.time() < deadline and t.is_alive():
+        now = time.time()
+        if now >= next_sample:
+            next_sample = now + args.sample_every
+            rec = {
+                "t": round(now - t0, 1),
+                "learner_step": pipe.learner_step,
+                "actor_steps": pipe.worker.actor_steps,
+                "restarts": pipe.worker.restarts,
+                "replay_size": pipe.fused.size if pipe.fused else None,
+                "kills": kills,
+            }
+            if steps_seen and rec["learner_step"] < steps_seen[-1]:
+                ok_monotone = False
+            steps_seen.append(rec["learner_step"])
+            out.write(json.dumps(rec) + "\n")
+            out.flush()
+        if now >= next_kill:
+            next_kill = now + args.kill_every
+            procs = [q for q in pipe.worker.pool._procs if q.is_alive()]
+            if procs:
+                victim = rng.choice(procs)
+                try:
+                    # Races the supervisor's respawn/exit by design — a
+                    # victim that died between the snapshot and the kill
+                    # just skips this round.
+                    os.kill(victim.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    continue
+                kills += 1
+                out.write(json.dumps(
+                    {"t": round(now - t0, 1), "event": "SIGKILL",
+                     "pid": victim.pid}) + "\n")
+                out.flush()
+        time.sleep(1.0)
+
+    final_step = pipe.learner_step
+    pipe.stop_event.set()
+    t.join(timeout=120.0)
+    devnull.close()
+
+    # Resume leg: a fresh pipeline restores the newest checkpoint and
+    # trains a short continuation.
+    from ape_x_dqn_tpu.utils.checkpoint import latest_step
+
+    ckpt_step = latest_step(args.ckpt_dir)
+    resume_ok, resume_from, resume_to = False, None, None
+    if ckpt_step:
+        cfg2 = build_cfg(args.ckpt_dir, resume=True)
+        devnull = open(os.devnull, "w")
+        pipe2 = AsyncPipeline(cfg2, logger=MetricLogger(stream=devnull),
+                              log_every=10**9)
+        resume_from = pipe2.learner_step
+        result = pipe2.run(
+            learner_steps=resume_from + 4 * cfg2.learner.steps_per_call,
+            warmup_timeout=600.0,
+        )
+        resume_to = result["step"]
+        resume_ok = resume_from >= ckpt_step and resume_to > resume_from
+        devnull.close()
+
+    grew = steps_seen and steps_seen[-1] > (steps_seen[0] if steps_seen else 0)
+    summary = {
+        "summary": True,
+        "wall_minutes": round((time.time() - t0) / 60.0, 1),
+        "final_learner_step": final_step,
+        "kills": kills,
+        "restarts": pipe.worker.restarts,
+        "monotone_progress": ok_monotone,
+        "progress_grew": bool(grew),
+        "run_error": run_err[0] if run_err else None,
+        "checkpoint_step": ckpt_step,
+        "resume_from": resume_from,
+        "resume_to": resume_to,
+        "resume_ok": resume_ok,
+        "passed": (
+            ok_monotone and bool(grew) and kills > 0
+            and pipe.worker.restarts >= kills - 1 and not run_err
+            and resume_ok
+        ),
+    }
+    out.write(json.dumps(summary) + "\n")
+    out.close()
+    print(json.dumps(summary))
+    return 0 if summary["passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
