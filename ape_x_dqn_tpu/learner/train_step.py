@@ -182,8 +182,18 @@ def init_train_state(
     if target_dtype is None:
         target = jax.tree_util.tree_map(jnp.copy, params)
     else:
+        # A no-op astype (param dtype == target_dtype, e.g. bf16 params +
+        # bf16 target) returns the SAME array — params and target_params
+        # would alias one buffer, and donating the TrainState then
+        # double-donates it: the TPU runtime rejects the program with an
+        # opaque INVALID_ARGUMENT (round-3's "bf16 params don't compile"
+        # was exactly this).  Force a real copy on the no-op path.
         target = jax.tree_util.tree_map(
-            lambda p: p.astype(target_dtype), params
+            lambda p: (
+                jnp.copy(p) if p.dtype == target_dtype
+                else p.astype(target_dtype)
+            ),
+            params,
         )
     return TrainState(
         params=params,
