@@ -86,7 +86,10 @@ def test_canonical_configs_load_and_validate():
     assert c3.actor.mode == "process"
     c4 = cfgs["config4_dp_v4_8_512actors.json"]
     assert c4.learner.data_parallel == 4 and c4.actor.num_actors == 512
-    assert c4.replay.frame_compression
+    # The north-star mode (BASELINE config 4): fused HBM replay sharded
+    # over the DP mesh — 2M slots / 4 devices ≈ 7 GB/device of rings,
+    # sized for a v4-8's 32 GB/chip HBM (not single-chip v5e).
+    assert c4.learner.device_replay and c4.learner.sample_ahead
     c5 = cfgs["config5_sweep_atari57_base.json"]
     assert c5.learner.device_replay
 
