@@ -7,6 +7,8 @@
   * ``"random"`` / ``"random:HxWxC"`` — RandomFrameEnv (throughput benches)
   * ``"fake-atari"`` — the full DQN wrapper stack over the ALE-faithful
     fake emulator (lives counter, sprite flicker — envs/fake_atari.py)
+  * ``"gym:Id"``    — a REAL installed gymnasium env quantized to uint8
+    (e.g. ``"gym:CartPole-v1"`` — classic control works in this image)
   * anything else   — the full Atari preprocessing stack via gymnasium
     (reference env.py:3-4's ``gym.make``, plus the wrappers it lacked).
 """
@@ -19,8 +21,10 @@ from ape_x_dqn_tpu.envs.atari import (
     FrameStack,
     GymnasiumEnv,
     ObsPreprocess,
+    QuantizeObs,
     RewardClip,
     make_atari_env,
+    make_gym_env,
     make_local_env,
     wrap_dqn,
 )
@@ -52,6 +56,10 @@ def make_env(spec: str, seed: int = 0, **atari_kwargs) -> Env:
         else:
             dims = (84, 84, 1)
         return RandomFrameEnv(obs_shape=dims, seed=seed)
+    if spec.startswith("gym:"):
+        # A REAL installed gymnasium env (classic control in this image),
+        # quantized to the uint8 wire format — e.g. "gym:CartPole-v1".
+        return make_gym_env(spec.split(":", 1)[1])
     if spec == "fake-atari":
         # The full DQN wrapper stack over the ALE-faithful fake emulator
         # (envs/fake_atari.py) — end-to-end Atari-shaped training without
@@ -73,6 +81,7 @@ __all__ = [
     "FrameStack",
     "GymnasiumEnv",
     "ObsPreprocess",
+    "QuantizeObs",
     "RandomFrameEnv",
     "RewardClip",
     "StepResult",
@@ -80,6 +89,7 @@ __all__ = [
     "VectorStep",
     "make_atari_env",
     "make_env",
+    "make_gym_env",
     "make_fake_atari_env",
     "make_local_env",
     "wrap_dqn",

@@ -53,6 +53,71 @@ def make_local_env(env_name: str) -> GymnasiumEnv:
     return GymnasiumEnv(gymnasium.make(env_name))
 
 
+class QuantizeObs:
+    """Affinely map a bounded float observation box to uint8.
+
+    The framework's wire format is uint8 end-to-end (types.py design note:
+    HBM bandwidth and replay RAM are the bottleneck), so non-pixel
+    gymnasium envs (classic control: float Box spaces) quantize at the env
+    boundary: obs -> round(255 * (obs - low) / (high - low)), clipped.
+    Infinite box bounds (CartPole's velocity dims) clamp to ``inf_bound``.
+
+    This is the seam that lets a REAL installed gymnasium env drive the
+    whole stack (fleet -> replay -> learner) in this ALE-less image —
+    reference env.py:3-4 constructs real gym envs; this is the TPU-native
+    framework's equivalent capability.
+    """
+
+    def __init__(self, env: Env, low=None, high=None, inf_bound: float = 10.0):
+        self._env = env
+        self.num_actions = env.num_actions
+        shape = tuple(env.observation_shape)
+        self.observation_shape = shape
+        if low is None or high is None:
+            space = getattr(getattr(env, "unwrapped", env), "observation_space", None)
+            if space is None or not hasattr(space, "low"):
+                raise ValueError(
+                    "QuantizeObs needs explicit low/high bounds when the env "
+                    "has no Box observation_space"
+                )
+            low = np.asarray(space.low, np.float64) if low is None else low
+            high = np.asarray(space.high, np.float64) if high is None else high
+        low = np.broadcast_to(np.asarray(low, np.float64), shape).copy()
+        high = np.broadcast_to(np.asarray(high, np.float64), shape).copy()
+        low[~np.isfinite(low)] = -float(inf_bound)
+        high[~np.isfinite(high)] = float(inf_bound)
+        if np.any(high <= low):
+            raise ValueError("QuantizeObs requires high > low per dimension")
+        self._low, self._scale = low, 255.0 / (high - low)
+
+    def _q(self, obs: np.ndarray) -> np.ndarray:
+        x = (np.asarray(obs, np.float64) - self._low) * self._scale
+        return np.clip(np.round(x), 0, 255).astype(np.uint8)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        return self._q(self._env.reset(seed))
+
+    def step(self, action: int) -> StepResult:
+        r = self._env.step(action)
+        return r._replace(obs=self._q(r.obs))
+
+    @property
+    def unwrapped(self):
+        return getattr(self._env, "unwrapped", self._env)
+
+
+def make_gym_env(env_name: str, inf_bound: float = 10.0) -> Env:
+    """A real gymnasium env, quantized to the framework's uint8 wire format.
+
+    Classic-control ids ('CartPole-v1', 'Acrobot-v1', ...) work out of the
+    box in this image; Atari ids additionally need ale_py, which is NOT
+    installed here (import error recorded in tests/test_envs.py) — those go
+    through ``make_atari_env`` when available.
+    """
+    env = make_local_env(env_name)
+    return QuantizeObs(env, inf_bound=inf_bound)
+
+
 class ObsPreprocess:
     """Grayscale + resize to (height, width) uint8 — the intended capability
     of reference actor.py:117-119 (84×84 grayscale, parameters.json:3),

@@ -92,8 +92,17 @@ _SUFFIX_RE = re.compile(
 
 
 def canonical_game(env_name: str) -> str:
-    """'PongNoFrameskip-v4' / 'Pong-v4' / 'pong' -> 'Pong' (table key)."""
-    base = _SUFFIX_RE.sub("", env_name.split(":")[0])
+    """'PongNoFrameskip-v4' / 'ALE/Pong-v5' / 'gym:ALE/Pong-v5' / 'pong'
+    -> 'Pong' (table key)."""
+    if env_name.startswith("gym:"):
+        # Factory scheme (envs.make_env): the real id is AFTER the colon.
+        base = env_name.split(":", 1)[1]
+    else:
+        # Synthetic specs ('chain:6', 'random:84x84x1'): id is BEFORE it.
+        base = env_name.split(":")[0]
+    # Namespace prefixes (gymnasium v5 spells Atari ids 'ALE/Pong-v5');
+    # anything before the last '/' is namespace, not game.
+    base = _SUFFIX_RE.sub("", base.rsplit("/", 1)[-1])
     for key in ATARI_HUMAN_RANDOM:
         if key.lower() == base.lower():
             return key
@@ -176,6 +185,12 @@ class GreedyEvaluator:
         self._policy_step = build_policy_step(network, seed=seed + 777_001)
         self._seed = seed
         self._max_steps = int(max_episode_steps)
+        # Eval-invocation counter: folded into the reset seed and the policy
+        # rng step offset so successive evaluations at the --eval-every
+        # cadence sample independent episode starts instead of replaying
+        # identical initial conditions (round-4 advisor: correlated score
+        # estimates over training).
+        self._calls = 0
 
     def evaluate(self, params, episodes: int = 10) -> EvalResult:
         """Run until every env completes its share of ``episodes``.
@@ -193,19 +208,28 @@ class GreedyEvaluator:
         import jax
 
         params = jax.device_put(params)
-        obs = self.envs.reset(seed=self._seed)
+        call = self._calls
+        self._calls += 1
+        obs = self.envs.reset(seed=self._seed + call * 9_973)
         k = self.envs.num_envs
         quota = np.full(k, episodes // k, np.int64)
         quota[: episodes % k] += 1
         counts = np.zeros(k, np.int64)
         scores: List[float] = []
         step = 0
+        # Distinct exploration stream per invocation: the policy rng key is
+        # derived from an int32 counter, so mix the call index in with a
+        # Knuth-hash XOR kept within int32 range — unbounded call counts
+        # and per-call `episodes` changes cannot overflow the jitted
+        # argument or alias another call's whole step range (at worst two
+        # calls coincide on one step's tie-break draw).
+        mix = lambda s: ((call * 2654435761) ^ s) & 0x7FFFFFFF  # noqa: E731
         # Safety valve: even a policy that never finishes an episode
         # terminates (max_episode_steps per expected episode).
         limit = self._max_steps * max(1, episodes)
         while (counts < quota).any() and step < limit:
             actions, _ = jax.device_get(
-                self._policy_step(params, obs, self._epsilons, step)
+                self._policy_step(params, obs, self._epsilons, mix(step))
             )
             vs = self.envs.step(actions)
             obs = vs.reset_obs

@@ -221,7 +221,7 @@ class AsyncPipeline:
         log_every: int = 500,
         prefetch_depth: int = 2,
         max_actor_restarts: int = 3,
-        fused_inflight: int = 2,
+        fused_inflight: int | None = None,
         eval_every: int = 0,
         eval_episodes: int = 10,
     ):
@@ -253,10 +253,14 @@ class AsyncPipeline:
         # ``fused_inflight`` calls amortizes that penalty instead of paying
         # it per call (measured: per-call forcing caps the process-mode
         # learner ~3x below its solo rate).
-        self._fused_inflight = max(1, int(fused_inflight))
+        # ``None`` = mode-dependent default (2 thread / 8 process — the
+        # measured sweet spots above); an explicit value is honored as
+        # passed (round-4 advisor: the old max(value, 8) silently deepened
+        # the staleness window beyond what the caller asked for).
         self._fused_drain_all = cfg.actor.mode == "process"
-        if self._fused_drain_all:
-            self._fused_inflight = max(self._fused_inflight, 8)
+        if fused_inflight is None:
+            fused_inflight = 8 if self._fused_drain_all else 2
+        self._fused_inflight = max(1, int(fused_inflight))
         self.fused = None
         self.mesh = None
         # SPMD process identity (multi-host; 1/0 when jax.distributed was

@@ -204,7 +204,6 @@ class FusedDeviceLearner:
         """
         with self._lock:
             staged, self._staged = self._staged, []
-            rows = self._staged_rows
             self._staged_rows = 0
         if not staged:
             return 0

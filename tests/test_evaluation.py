@@ -25,6 +25,14 @@ class TestScoreTable:
         assert canonical_game("pong") == "Pong"
         assert canonical_game("chain:6") == "chain"
 
+    def test_canonical_game_strips_namespace_prefix(self):
+        # gymnasium v5 spelling (round-4 advisor: eval/hns silently became
+        # None for namespaced ids).
+        assert canonical_game("ALE/Pong-v5") == "Pong"
+        assert canonical_game("ALE/MsPacman-v5") == "MsPacman"
+        assert canonical_game("gym:ALE/Pong-v5") == "Pong"
+        assert canonical_game("gym:CartPole-v1") == "CartPole"
+
     def test_human_normalized_anchors(self):
         # By construction: random play = 0, human = 1.
         assert human_normalized("PongNoFrameskip-v4", -20.7) == pytest.approx(0.0)
@@ -96,6 +104,27 @@ class TestGreedyEvaluator:
         assert res.mean_score == pytest.approx(10.0)
         assert res.median_score == pytest.approx(10.0)
         assert res.hns is None  # not an Atari game
+
+    def test_repeated_evals_sample_independent_starts(self):
+        """Successive evaluate() calls must NOT replay identical initial
+        conditions (round-4 advisor: same reset seed + rng step 0 every call
+        gave correlated score estimates over training)."""
+        import jax
+
+        from ape_x_dqn_tpu.models.dueling import DuelingMLP
+
+        net = DuelingMLP(num_actions=2, hidden_sizes=(8,))
+        params = net.init(jax.random.PRNGKey(0), np.zeros((1, 3), np.uint8))
+        ev = GreedyEvaluator(
+            [FixedEpisodeEnv] * 2, net, env_name="fixed", seed=1
+        )
+        seeds = []
+        inner_reset = ev.envs.reset
+        ev.envs.reset = lambda seed=None: (seeds.append(seed), inner_reset(seed=seed))[1]
+        ev.evaluate(params, episodes=2)
+        ev.evaluate(params, episodes=2)
+        ev.evaluate(params, episodes=2)
+        assert len(set(seeds)) == 3, f"reset seeds repeated: {seeds}"
 
     def test_trained_chain_policy_scores_optimal(self):
         """Greedy eval of a trained chain policy: every episode reaches the
