@@ -47,14 +47,19 @@ import numpy as np
 from ape_x_dqn_tpu.envs.vector import SyncVectorEnv
 from ape_x_dqn_tpu.ops.exploration import epsilon_greedy, epsilon_ladder
 from ape_x_dqn_tpu.ops.nstep import nstep_returns_np
-from ape_x_dqn_tpu.types import NStepTransition
+from ape_x_dqn_tpu.types import DedupChunk, NStepTransition
 
 
 class Chunk(NamedTuple):
-    """One flush: transitions + actor-computed initial priorities."""
+    """One flush: transitions + actor-computed initial priorities.
+
+    ``transitions`` is an ``NStepTransition`` batch (dense wire format) or,
+    with the fleet's ``emit_dedup=True``, a ``DedupChunk`` (each frame
+    once + refs) — consumers are wired by the same config knob.
+    """
 
     priorities: np.ndarray        # float32 [M]
-    transitions: NStepTransition  # numpy leaves, batch M
+    transitions: object           # NStepTransition | DedupChunk, batch M
     actor_steps: int              # fleet env steps this chunk covers
 
 
@@ -115,6 +120,7 @@ class ActorFleet:
         epsilon_index_offset: int = 0,
         epsilon_total: int | None = None,
         emission: str = "overlapping",
+        emit_dedup: bool = False,
     ):
         self.envs = SyncVectorEnv(env_fns)
         self.network = network
@@ -133,6 +139,11 @@ class ActorFleet:
             raise ValueError(
                 "strided emission needs flush_every >= num_steps (a flush "
                 "window shorter than the stride can contain no aligned start)"
+            )
+        if emit_dedup and self.flush_every < self.n_step:
+            raise ValueError(
+                "dedup emission needs flush_every >= num_steps — carry refs "
+                "reach at most one chunk back (types.DedupChunk contract)"
             )
         N = self.envs.num_envs
         # When this fleet is one shard of a larger actor set (process-
@@ -169,6 +180,17 @@ class ActorFleet:
         self._step_count = 0    # total fleet steps
         self.params = None
         self.param_version = -1
+        # Dedup emission state (types.DedupChunk): a fresh random source id
+        # per fleet INSTANCE — a respawned worker's new fleet bootstraps a
+        # self-contained first chunk, so consumers never resolve carry refs
+        # across an incarnation gap.
+        self.emit_dedup = bool(emit_dedup)
+        import os as _os
+
+        self._source = int.from_bytes(_os.urandom(8), "little") >> 1
+        self._chunk_seq = 0
+        self._last_U = 0        # previous chunk's total frame count
+        self._last_bw = 0       # previous chunk's base window row
 
     @property
     def num_actors(self) -> int:
@@ -242,11 +264,13 @@ class ActorFleet:
         returns, boot = nstep_returns_np(rewards, discounts, n)  # [F, N]
         returns, boot = returns[starts], boot[starts]            # [S, N]
         next_idx = order[starts + n]
-        obs = self._hist_obs[order[starts]]            # [S, N, *obs]
-        next_obs = self._hist_obs[next_idx]            # [S, N, *obs]
         qtaken = self._hist_qtaken[order[starts]]
         boot_qmax = self._hist_qmax[next_idx]
         truncs = self._hist_trunc[order[: F + n - 1]]  # [F+n-1, N]
+        # trunc_k[j, a] = offset k of the truncation that re-targets window
+        # (starts[j], a)'s next_obs (−1: none) — index-level so the dense
+        # and dedup materializations below share ONE branch structure.
+        trunc_k = np.full((S, N), -1, np.int64)
         if truncs.any():
             # Truncation bootstrap (envs/core.py:24-28): a window whose
             # FIRST done is a truncation at offset k re-targets next_obs to
@@ -256,28 +280,99 @@ class ActorFleet:
             # — the last Q computed before the final obs — as the bootstrap
             # proxy (the final obs never went through the policy net); the
             # learner restamps with the exact value on first replay.
-            trunc_obs_seq = self._hist_trunc_obs[order[: F + n - 1]]
             qmax_seq = self._hist_qmax[order[: F + n - 1]]
             alive = np.ones(boot.shape, bool)          # no done before k
             for k in range(n):
                 m = alive & truncs[starts + k]
                 if m.any():
                     boot[m] = self.gamma ** (k + 1)
-                    next_obs[m] = trunc_obs_seq[starts + k][m]
+                    trunc_k[m] = k
                     boot_qmax[m] = qmax_seq[starts + k][m]
                 alive &= discounts[starts + k] != 0.0
         # Actor priority rule: |n-step TD error| with max-Q bootstrap
         # (reference actor.py:138-142), per transition (not collapsed).
         td = returns + boot * boot_qmax - qtaken
         priorities = np.abs(td).astype(np.float32).reshape(-1)
-        transitions = NStepTransition(
-            obs=obs.reshape(S * N, *obs.shape[2:]),
-            action=self._hist_action[order[starts]].reshape(-1),
-            reward=returns.reshape(-1).astype(np.float32),
-            discount=boot.reshape(-1).astype(np.float32),
-            next_obs=next_obs.reshape(S * N, *next_obs.shape[2:]),
-        )
+        action = self._hist_action[order[starts]].reshape(-1)
+        reward = returns.reshape(-1).astype(np.float32)
+        discount = boot.reshape(-1).astype(np.float32)
+        if self.emit_dedup:
+            transitions = self._build_dedup(
+                order, starts, trunc_k, action, reward, discount
+            )
+        else:
+            obs = self._hist_obs[order[starts]]            # [S, N, *obs]
+            next_obs = self._hist_obs[next_idx]            # [S, N, *obs]
+            for k in range(n):
+                m = trunc_k == k
+                if m.any():
+                    next_obs[m] = self._hist_trunc_obs[order[starts + k]][m]
+            transitions = NStepTransition(
+                obs=obs.reshape(S * N, *obs.shape[2:]),
+                action=action,
+                reward=reward,
+                discount=discount,
+                next_obs=next_obs.reshape(S * N, *next_obs.shape[2:]),
+            )
         return Chunk(priorities, transitions, F * N)
+
+    def _build_dedup(self, order, starts, trunc_k, action, reward, discount
+                     ) -> DedupChunk:
+        """Assemble the frame-dedup wire format (types.DedupChunk) for this
+        flush: ship only the F NEW step rows (all H on the bootstrap flush)
+        plus truncation extras; windows overlapping the previous flush
+        carry negative refs into its tail."""
+        n, F, N = self.n_step, self.flush_every, self.num_actors
+        H = self._H
+        bw = 0 if self._chunk_seq == 0 else n   # first NEW window row
+        rows = order[bw:H]                       # new step rows, oldest→newest
+        step_frames = self._hist_obs[rows]       # [H-bw, N, *obs]
+        obs_shape = step_frames.shape[2:]
+        S = len(starts)
+        a_grid = np.broadcast_to(np.arange(N), (S, N))
+        s_grid = np.broadcast_to(starts[:, None], (S, N))
+        in_chunk = s_grid >= bw
+        obs_ref = np.where(
+            in_chunk,
+            (s_grid - bw) * N + a_grid,
+            # Carry: window row σ (< bw = n) was the previous chunk's
+            # window row σ + F, at its step index (σ + F − prev_bw)·N + a;
+            # negative refs are relative to the previous chunk's END.
+            (s_grid + F - self._last_bw) * N + a_grid - self._last_U,
+        ).astype(np.int64)
+        next_ref = ((s_grid + n - bw) * N + a_grid).astype(np.int64)
+        extras = []
+        extra_index: dict = {}
+        if (trunc_k >= 0).any():
+            for j, a in zip(*np.nonzero(trunc_k >= 0)):
+                k = int(trunc_k[j, a])
+                t_row = int(starts[j] + k)       # window row of the trunc
+                key = (t_row, int(a))
+                if key not in extra_index:
+                    extra_index[key] = len(extras)
+                    extras.append(
+                        self._hist_trunc_obs[order[t_row]][a]
+                    )
+                next_ref[j, a] = (H - bw) * N + extra_index[key]
+        U_step = (H - bw) * N
+        frames = step_frames.reshape(U_step, *obs_shape)
+        if extras:
+            frames = np.concatenate([frames, np.stack(extras)], axis=0)
+        chunk = DedupChunk(
+            frames=frames,
+            obs_ref=obs_ref.reshape(-1).astype(np.int32),
+            next_ref=next_ref.reshape(-1).astype(np.int32),
+            action=action,
+            reward=reward,
+            discount=discount,
+            source=self._source,
+            chunk_seq=self._chunk_seq,
+            prev_frames=self._last_U,
+        )
+        self._chunk_seq += 1
+        self._last_U = frames.shape[0]
+        self._last_bw = bw
+        return chunk
 
     def collect(
         self,

@@ -34,6 +34,7 @@ from ape_x_dqn_tpu.envs.core import (
     ChainMDP,
     Env,
     LoopEnv,
+    PixelUpscale,
     RandomFrameEnv,
     StepResult,
 )
@@ -45,8 +46,14 @@ def make_env(spec: str, seed: int = 0, **atari_kwargs) -> Env:
     if spec.startswith("chain"):
         n = int(spec.split(":")[1]) if ":" in spec else 10
         return ChainMDP(n_states=n)
-    if spec == "catch":
-        return CatchEnv(seed=seed)
+    if spec.startswith("catch"):
+        # "catch" = the raw 10x5 board; "catch:S" = upscaled to SxS pixels
+        # (conv-net scale — same tiny MDP, real 84x84 frame shapes).
+        env = CatchEnv(seed=seed)
+        if ":" in spec:
+            size = int(spec.split(":")[1])
+            env = PixelUpscale(env, size, size)
+        return env
     if spec.startswith("loop"):
         t = int(spec.split(":")[1]) if ":" in spec else 10
         return LoopEnv(time_limit=t)
@@ -81,6 +88,7 @@ __all__ = [
     "FrameStack",
     "GymnasiumEnv",
     "ObsPreprocess",
+    "PixelUpscale",
     "QuantizeObs",
     "RandomFrameEnv",
     "RewardClip",

@@ -125,6 +125,39 @@ class CatchEnv:
         return StepResult(self._obs(), 0.0, False, False)
 
 
+class PixelUpscale:
+    """Integer-upscale (nearest-neighbor) + zero-pad a pixel env to a fixed
+    (height, width) — e.g. Catch's 10×5 board to the conv net's 84×84.
+
+    Keeps the game's state space tiny while exercising the REAL conv
+    model/replay/learner shapes — the conv-scale learning workload this
+    image supports without ALE (used by the ``catch:84`` factory spec and
+    the hour-scale learning soak, tools/longrun.py).
+    """
+
+    def __init__(self, env: Env, height: int = 84, width: int = 84):
+        r, c, ch = env.observation_shape
+        if height < r or width < c:
+            raise ValueError("target size smaller than source observation")
+        self._env = env
+        self._fy, self._fx = height // r, width // c
+        py, px = height - r * self._fy, width - c * self._fx
+        self._pad = ((py // 2, py - py // 2), (px // 2, px - px // 2), (0, 0))
+        self.observation_shape = (height, width, ch)
+        self.num_actions = env.num_actions
+
+    def _up(self, obs: np.ndarray) -> np.ndarray:
+        out = obs.repeat(self._fy, axis=0).repeat(self._fx, axis=1)
+        return np.pad(out, self._pad)
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        return self._up(self._env.reset(seed))
+
+    def step(self, action: int) -> StepResult:
+        r = self._env.step(action)
+        return r._replace(obs=self._up(r.obs))
+
+
 class LoopEnv:
     """Single-state env paying +1 per step, ending only by time-limit
     truncation — the sharpest probe of truncation bootstrapping.

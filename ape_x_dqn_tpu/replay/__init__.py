@@ -1,6 +1,8 @@
-"""Replay subsystem: sum-tree priorities + prioritized ring-buffer store."""
+"""Replay subsystem: sum-tree priorities + prioritized ring-buffer stores
+(double-store, frame-dedup, and their HBM device twins)."""
 
 from ape_x_dqn_tpu.replay.buffer import PrioritizedReplay
+from ape_x_dqn_tpu.replay.dedup import DedupReplay
 from ape_x_dqn_tpu.replay.sum_tree import SumTree
 
-__all__ = ["PrioritizedReplay", "SumTree"]
+__all__ = ["DedupReplay", "PrioritizedReplay", "SumTree"]

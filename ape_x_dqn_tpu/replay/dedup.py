@@ -1,0 +1,328 @@
+"""Frame-dedup prioritized replay — each frame stored ONCE (host path).
+
+Round-4 verdict item 1a: ``PrioritizedReplay`` (replay/buffer.py) carries
+full ``obs`` AND ``next_obs`` arrays — the direct cause of config3's 28 GB
+at 2M slots (it would be ~14 GB stored once) and a 2× tax on host RAM,
+snapshot size, and ingest bandwidth.  This buffer stores a single FRAME
+RING plus per-transition frame references (types.DedupChunk wire format,
+produced by ``ActorFleet(emit_dedup=True)``):
+
+  * **frame ring** — ``frame_capacity ≈ frame_ratio × capacity`` unique
+    observations addressed by a monotone int64 sequence number (slot =
+    seq % Cf).  Steady-state arrival is ~1 frame per transition (the
+    sliding-window emission shares every interior frame between the
+    transition that uses it as S_t and the one n earlier that uses it as
+    S_{t+n}, and consecutive chunks carry their n-row overlap), so the
+    default ``frame_ratio=1.25`` leaves slack for truncation extras and
+    source interleaving while still cutting storage ~1.6-2×.
+  * **transition ring** — (obs_seq, next_seq, action, reward, discount)
+    per slot, FIFO like the double-store; the sum-tree is unchanged.
+  * **invalidation sweep** — when new frames overwrite ring slots, any
+    transition whose ``obs_seq`` fell out of the live window gets its
+    priority zeroed (one vectorized compare per add), so a sampled
+    transition's frames are ALWAYS its own: the ring can never pair a
+    stale transition with a recycled frame.  ``update_priorities`` applies
+    the same liveness guard, so a deferred learner restamp cannot
+    resurrect a frame-dead slot.
+
+Same sampling law, IS weights, and FIFO semantics as ``PrioritizedReplay``
+(equal-semantics tests: tests/test_dedup.py); reference capability mapping
+identical to replay/buffer.py (reference replay.py:8-83).
+
+A C++ twin of this structure lives in ``_native/replay_core.cc``
+(replay/native_dedup.py) for the paper-scale host path; this numpy version
+is the always-available fallback and the oracle the native one is pinned
+against.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ape_x_dqn_tpu.types import DedupChunk, NStepTransition, PrioritizedBatch
+
+
+class DedupReplay:
+    """Prioritized n-step transition store over a shared frame ring.
+
+    Args mirror ``PrioritizedReplay`` plus:
+      frame_ratio: frame-ring slots per transition slot.  Must cover the
+        actual frame/transition arrival ratio (≈ (flush_every + n_step) /
+        flush_every for overlapping emission, + truncation extras) or the
+        frame ring wraps early and the oldest transitions are invalidated
+        before their FIFO death — gracefully (they become unsampleable),
+        but effective capacity shrinks.  ``stats["frame_dead"]`` counts
+        those; size the ratio so it stays ~0.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        obs_shape,
+        priority_exponent: float = 0.6,
+        obs_dtype=np.uint8,
+        sum_tree_cls=None,
+        frame_ratio: float = 1.25,
+    ):
+        if sum_tree_cls is None:
+            from ape_x_dqn_tpu.replay.native import default_sum_tree_cls
+
+            sum_tree_cls = default_sum_tree_cls()
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        if frame_ratio <= 0:
+            raise ValueError("frame_ratio must be positive")
+        self.capacity = int(capacity)
+        self.frame_capacity = max(1, int(round(capacity * frame_ratio)))
+        self.alpha = float(priority_exponent)
+        self._frames = np.zeros((self.frame_capacity, *obs_shape), obs_dtype)
+        self._obs_seq = np.zeros((capacity,), np.int64)
+        self._next_seq = np.zeros((capacity,), np.int64)
+        self._action = np.zeros((capacity,), np.int32)
+        self._reward = np.zeros((capacity,), np.float32)
+        self._discount = np.zeros((capacity,), np.float32)
+        self._alive = np.zeros((capacity,), bool)
+        self._tree = sum_tree_cls(capacity)
+        self._cursor = 0
+        self._count = 0          # transitions ever accepted
+        self._fcount = 0         # frames ever written (monotone seq)
+        # source -> (chunk_seq, frame_base, total_frames) of its last chunk.
+        self._sources: dict = {}
+        self._max_sources = 4096
+        self.stats = {"frame_dead": 0, "dropped_carry": 0}
+        self._lock = threading.Lock()
+
+    # -- write path (actors / drain) ------------------------------------
+
+    def add(self, priorities: np.ndarray, chunk: DedupChunk) -> np.ndarray:
+        """Ingest one dedup chunk; returns the transition slots written.
+
+        Carry refs resolve against this source's previous chunk; a
+        ``chunk_seq`` gap or frame-count mismatch (dropped chunk, worker
+        respawn without a bootstrap) drops just the carried rows, counted
+        in ``stats["dropped_carry"]``.
+        """
+        priorities = np.asarray(priorities, dtype=np.float64)
+        U = chunk.frames.shape[0]
+        M = priorities.shape[0]
+        if M != chunk.action.shape[0]:
+            raise ValueError("priorities/chunk length mismatch")
+        if M > self.capacity:
+            raise ValueError(f"chunk of {M} exceeds capacity {self.capacity}")
+        if U > self.frame_capacity:
+            raise ValueError(
+                f"chunk of {U} frames exceeds frame ring {self.frame_capacity}"
+            )
+        with self._lock:
+            base = self._fcount
+            prev = self._sources.get(chunk.source)
+            contiguous = (
+                prev is not None
+                and chunk.chunk_seq == prev[0] + 1
+                and chunk.prev_frames == prev[2]
+            )
+            neg = chunk.obs_ref < 0
+            obs_seq = base + chunk.obs_ref.astype(np.int64)
+            if neg.any():
+                if contiguous:
+                    # prev chunk's frames end exactly at prev[1] + prev[2];
+                    # ref r < 0 names its frame prev_end + r.
+                    obs_seq[neg] = prev[1] + prev[2] + chunk.obs_ref[neg]
+                    keep = np.ones(M, bool)
+                else:
+                    keep = ~neg
+                    self.stats["dropped_carry"] += int(neg.sum())
+            else:
+                keep = np.ones(M, bool)
+            next_seq = base + chunk.next_ref.astype(np.int64)
+            # Frames land regardless of dropped rows (the NEXT chunk's
+            # carry refs point into them).
+            fidx = (base + np.arange(U)) % self.frame_capacity
+            self._frames[fidx] = chunk.frames
+            self._fcount = base + U
+            self._sources[chunk.source] = (chunk.chunk_seq, base, U)
+            if len(self._sources) > self._max_sources:
+                # Evict the stalest source records (dead fleets).
+                for key in sorted(
+                    self._sources, key=lambda s: self._sources[s][1]
+                )[: len(self._sources) // 2]:
+                    del self._sources[key]
+            m = int(keep.sum())
+            idx = np.zeros(0, np.int64)
+            if m:
+                idx = (self._cursor + np.arange(m)) % self.capacity
+                self._obs_seq[idx] = obs_seq[keep]
+                self._next_seq[idx] = next_seq[keep]
+                self._action[idx] = chunk.action[keep]
+                self._reward[idx] = chunk.reward[keep]
+                self._discount[idx] = chunk.discount[keep]
+                self._alive[idx] = True
+                self._tree.set(
+                    idx,
+                    np.power(np.maximum(priorities[keep], 1e-12), self.alpha),
+                )
+                self._cursor = int((self._cursor + m) % self.capacity)
+                self._count += m
+            self._sweep_locked()
+            return idx
+
+    def _sweep_locked(self) -> None:
+        """Zero the priority of transitions whose obs frame was overwritten
+        (obs_seq is each row's OLDEST ref — the DedupChunk layout contract)."""
+        fmin = self._fcount - self.frame_capacity
+        if fmin <= 0:
+            return
+        dead = self._alive & (self._obs_seq < fmin)
+        if dead.any():
+            di = np.nonzero(dead)[0]
+            self._tree.set(di, np.zeros(len(di)))
+            self._alive[di] = False
+            self.stats["frame_dead"] += len(di)
+
+    # -- read path (learner) --------------------------------------------
+
+    def sample(
+        self,
+        batch_size: int,
+        beta: float = 0.4,
+        rng: Optional[np.random.Generator] = None,
+    ) -> PrioritizedBatch:
+        """Stratified proportional sample with IS weights — the law and
+        weight math of ``PrioritizedReplay.sample`` verbatim; only the
+        frame gather goes through the ref indirection."""
+        rng = rng or np.random.default_rng()
+        with self._lock:
+            size = min(self._count, self.capacity)
+            if size == 0:
+                raise ValueError("cannot sample from an empty replay")
+            idx = self._tree.sample_stratified(batch_size, rng)
+            mass = self._tree.get(idx)
+            total = self._tree.total
+            transition = NStepTransition(
+                obs=self._frames[self._obs_seq[idx] % self.frame_capacity],
+                action=self._action[idx].copy(),
+                reward=self._reward[idx].copy(),
+                discount=self._discount[idx].copy(),
+                next_obs=self._frames[self._next_seq[idx] % self.frame_capacity],
+            )
+        probs = mass / total
+        weights = np.power(size * np.maximum(probs, 1e-12), -beta)
+        weights = (weights / weights.max()).astype(np.float32)
+        return PrioritizedBatch(
+            transition=transition,
+            indices=idx.astype(np.int32),
+            is_weights=weights,
+        )
+
+    def update_priorities(self, indices: np.ndarray, priorities: np.ndarray) -> None:
+        """Learner priority feedback, with the liveness guard: a restamp
+        must not resurrect a frame-dead slot (its frames belong to newer
+        transitions now — sampling it would pair stale metadata with
+        recycled pixels).  Slot-recycled-by-a-newer-transition keeps the
+        double-store's benign self-correcting race."""
+        indices = np.asarray(indices, dtype=np.int64)
+        priorities = np.asarray(priorities, dtype=np.float64)
+        if indices.size == 0:
+            return
+        with self._lock:
+            fmin = self._fcount - self.frame_capacity
+            live = self._alive[indices] & (self._obs_seq[indices] >= fmin)
+            if live.any():
+                self._tree.set(
+                    indices[live],
+                    np.power(
+                        np.maximum(priorities[live], 1e-12), self.alpha
+                    ),
+                )
+
+    # -- misc ------------------------------------------------------------
+
+    def size(self) -> int:
+        with self._lock:
+            return min(self._count, self.capacity)
+
+    @property
+    def total_added(self) -> int:
+        return self._count
+
+    def frames_nbytes(self) -> int:
+        """Bytes held by frame storage — the dedup win's observable
+        (compare: the double-store's 2 × capacity × frame_bytes)."""
+        return self._frames.nbytes
+
+    def max_priority(self) -> float:
+        with self._lock:
+            m = self._tree.max_priority()
+        return float(m ** (1.0 / self.alpha)) if m > 0 else 1.0
+
+    # -- snapshot (checkpointing) ----------------------------------------
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            size = min(self._count, self.capacity)
+            idx = np.arange(size)
+            nf = min(self._fcount, self.frame_capacity)
+            src = self._sources
+            return {
+                "dedup": np.asarray(True),
+                "frames": self._frames[:nf].copy(),
+                "obs_seq": self._obs_seq[:size].copy(),
+                "next_seq": self._next_seq[:size].copy(),
+                "action": self._action[:size].copy(),
+                "reward": self._reward[:size].copy(),
+                "discount": self._discount[:size].copy(),
+                "alive": self._alive[:size].copy(),
+                "tree_priorities": self._tree.get(idx),
+                "cursor": self._cursor,
+                "count": self._count,
+                "fcount": self._fcount,
+                "frame_capacity": self.frame_capacity,
+                "src_ids": np.array(list(src.keys()), np.int64),
+                "src_state": np.array(
+                    [list(v) for v in src.values()], np.int64
+                ).reshape(len(src), 3),
+            }
+
+    def load_state_dict(self, state: dict) -> None:
+        if "dedup" not in state:
+            raise ValueError(
+                "snapshot is not a dedup-replay snapshot (double-store "
+                "snapshots don't carry frame refs; re-collect instead)"
+            )
+        if int(state["frame_capacity"]) != self.frame_capacity:
+            raise ValueError(
+                f"snapshot frame ring {int(state['frame_capacity'])} != "
+                f"configured {self.frame_capacity} — frame slots are "
+                "addressed seq % capacity, so the layout must match"
+            )
+        with self._lock:
+            size = state["obs_seq"].shape[0]
+            if size > self.capacity:
+                raise ValueError("snapshot larger than capacity")
+            self._tree.set(
+                np.arange(self.capacity), np.zeros(self.capacity)
+            )
+            self._alive[:] = False
+            nf = state["frames"].shape[0]
+            self._fcount = int(state["fcount"])
+            # Snapshot frames are SLOT-ordered [0, nf): identity placement
+            # (seq % capacity addressing is stable across save/restore
+            # because frame_capacity is layout-checked above).
+            self._frames[:nf] = state["frames"]
+            rng = np.arange(size)
+            self._obs_seq[:size] = state["obs_seq"]
+            self._next_seq[:size] = state["next_seq"]
+            self._action[:size] = state["action"]
+            self._reward[:size] = state["reward"]
+            self._discount[:size] = state["discount"]
+            self._alive[:size] = state["alive"]
+            self._tree.set(rng, state["tree_priorities"])
+            self._cursor = int(state["cursor"]) % self.capacity
+            self._count = int(state["count"])
+            self._sources = {
+                int(s): tuple(int(x) for x in row)
+                for s, row in zip(state["src_ids"], state["src_state"])
+            }

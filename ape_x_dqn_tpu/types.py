@@ -20,7 +20,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +50,70 @@ class NStepTransition:
     @property
     def batch_shape(self):
         return self.action.shape
+
+
+class DedupChunk(NamedTuple):
+    """An actor flush with each frame stored ONCE — the frame-dedup wire
+    format (round-4 verdict item 1a: the double-store's ``obs`` +
+    ``next_obs`` is a 2× tax on RAM, ingest bandwidth, snapshots and HBM).
+
+    ``frames`` holds the flush's unique observations; each transition
+    references its S_t / S_{t+n} by index.  Refs are relative to THIS
+    chunk's first frame: ``r >= 0`` → ``frames[r]``; ``r < 0`` → frame
+    ``prev_frames + r`` of this source's PREVIOUS chunk (the n-row overlap
+    between consecutive sliding windows — consecutive chunks share their
+    boundary frames, so steady-state frame traffic is ~1 frame per
+    transition instead of 2).  Consumers resolve refs against a per-source
+    frame counter; a gap in ``chunk_seq`` (dropped/reordered chunk, worker
+    respawn) invalidates carry refs, and consumers drop just the carried
+    rows (≤ n·num_actors once per gap).
+
+    Layout contract (producers): frames are ordered [step-row-major, then
+    truncation extras]; ``obs_ref < next_ref`` row-wise (liveness checks
+    use ``obs_ref`` as each row's oldest frame).
+    """
+
+    frames: np.ndarray     # uint8 [U, *obs_shape] — each unique frame once
+    obs_ref: np.ndarray    # int32 [M] — S_t ref (may be negative: carry)
+    next_ref: np.ndarray   # int32 [M] — S_{t+n} ref (>= 0 always)
+    action: np.ndarray     # int32 [M]
+    reward: np.ndarray     # float32 [M] — n-step return
+    discount: np.ndarray   # float32 [M] — bootstrap factor
+    source: int            # producer identity (fresh per fleet incarnation)
+    chunk_seq: int         # per-source monotone flush counter
+    prev_frames: int       # U of this source's previous chunk (carry check)
+
+    @property
+    def batch_shape(self):
+        return self.action.shape
+
+
+def materialize_dedup(chunk: DedupChunk, prev: DedupChunk | None = None):
+    """Decode a DedupChunk (plus its predecessor, for carry refs) back to a
+    dense NStepTransition — the test oracle for emission equivalence and
+    the fallback for consumers that want the dense wire format."""
+    neg = chunk.obs_ref < 0
+    if neg.any():
+        if prev is None:
+            raise ValueError("chunk has carry refs but no previous chunk")
+        if prev.frames.shape[0] != chunk.prev_frames:
+            raise ValueError("previous chunk size mismatch for carry refs")
+        carry_idx = np.clip(chunk.prev_frames + chunk.obs_ref,
+                            0, chunk.prev_frames - 1)
+        obs = np.where(
+            neg[(...,) + (None,) * (chunk.frames.ndim - 1)],
+            prev.frames[carry_idx],
+            chunk.frames[np.clip(chunk.obs_ref, 0, None)],
+        )
+    else:
+        obs = chunk.frames[chunk.obs_ref]
+    return NStepTransition(
+        obs=obs,
+        action=chunk.action,
+        reward=chunk.reward,
+        discount=chunk.discount,
+        next_obs=chunk.frames[chunk.next_ref],
+    )
 
 
 @struct.dataclass

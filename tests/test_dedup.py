@@ -1,0 +1,296 @@
+"""Frame-dedup replay: equal-semantics vs the double-store + dedup-only
+edges (round-4 verdict item 1a).
+
+Levels:
+  1. EMISSION — ActorFleet(emit_dedup=True) decodes (types.materialize_dedup)
+     to byte-identical transitions + priorities vs the dense fleet, across
+     truncation-heavy, terminal, pixel, and strided workloads.
+  2. STORE — DedupReplay fed the dedup stream is observationally identical
+     to PrioritizedReplay fed the materialized stream: same slots, same
+     samples, same IS weights, same priority updates, through FIFO wrap.
+  3. DEDUP EDGES — frame-ring early death (sweep), carry-gap drops,
+     restamp-resurrection guard, checkpoint roundtrip with a wrapped ring.
+"""
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.replay import DedupReplay, PrioritizedReplay
+from ape_x_dqn_tpu.replay.sum_tree import SumTree
+from ape_x_dqn_tpu.types import DedupChunk, materialize_dedup
+
+OBS = (3, 3, 1)
+
+
+def frame(seq: int) -> np.ndarray:
+    """A frame whose content encodes its global sequence number."""
+    return np.full(OBS, seq % 251, np.uint8)
+
+
+def make_chunk(source: int, chunk_seq: int, fbase: int, n_tx: int = 4,
+               carry: int = 0, prev_frames: int = 0, extras: int = 0):
+    """A hand-built dedup chunk: ``n_tx + carry`` transitions over
+    ``n_tx + 1 + extras`` fresh frames (each S_{t+n} = next fresh frame;
+    ``carry`` rows reference the previous chunk's tail)."""
+    U = n_tx + 1 + extras
+    frames = np.stack([frame(fbase + i) for i in range(U)])
+    obs_ref = np.concatenate([
+        -np.arange(carry, 0, -1, dtype=np.int32),       # carry rows first
+        np.arange(n_tx, dtype=np.int32),
+    ])
+    next_ref = np.concatenate([
+        np.zeros(carry, np.int32),
+        np.arange(1, n_tx + 1, dtype=np.int32),
+    ])
+    m = n_tx + carry
+    rng = np.random.default_rng(chunk_seq * 977 + source % 1000)
+    return DedupChunk(
+        frames=frames,
+        obs_ref=obs_ref,
+        next_ref=next_ref,
+        action=rng.integers(0, 4, m).astype(np.int32),
+        reward=rng.normal(size=m).astype(np.float32),
+        discount=np.full(m, 0.97, np.float32),
+        source=source,
+        chunk_seq=chunk_seq,
+        prev_frames=prev_frames,
+    )
+
+
+def fleet_pair(env_fn, obs_dim, n_step=3, flush=5, steps=60,
+               emission="overlapping", num=3):
+    import jax
+
+    from ape_x_dqn_tpu.actors import ActorFleet, LocalParamSource
+    from ape_x_dqn_tpu.models.dueling import DuelingMLP
+
+    net = DuelingMLP(num_actions=env_fn().num_actions, hidden_sizes=(8,))
+    params = net.init(jax.random.PRNGKey(0), np.zeros((1, *obs_dim), np.uint8))
+    out = []
+    for dedup in (False, True):
+        fleet = ActorFleet(
+            [env_fn] * num, net, n_step=n_step, flush_every=flush, seed=7,
+            emission=emission, emit_dedup=dedup,
+        )
+        fleet.sync_params(LocalParamSource(params))
+        chunks, _ = fleet.collect(steps)
+        out.append(chunks)
+    return out
+
+
+class TestEmissionEquivalence:
+    @pytest.mark.parametrize("env_spec,obs_dim,kw", [
+        ("loop:7", (4,), {}),                          # truncation-heavy
+        ("chain:5", (5,), {}),                         # terminals + trunc
+        ("catch", (10, 5, 1), dict(flush=16, steps=96)),
+        ("chain:5", (5,), dict(emission="strided", flush=6)),
+    ])
+    def test_dedup_decodes_to_dense(self, env_spec, obs_dim, kw):
+        from ape_x_dqn_tpu.envs import make_env
+
+        dense, dd = fleet_pair(lambda: make_env(env_spec), obs_dim, **kw)
+        assert len(dense) == len(dd) and dense
+        prev = None
+        for i, (a, b) in enumerate(zip(dense, dd)):
+            np.testing.assert_array_equal(a.priorities, b.priorities)
+            assert b.transitions.chunk_seq == i
+            mat = materialize_dedup(b.transitions, prev)
+            for f in ("obs", "action", "reward", "discount", "next_obs"):
+                np.testing.assert_array_equal(
+                    getattr(a.transitions, f), getattr(mat, f),
+                    err_msg=f"{f} diverged in chunk {i}",
+                )
+            prev = b.transitions
+
+    def test_steady_state_frame_ratio_near_one(self):
+        from ape_x_dqn_tpu.envs import make_env
+
+        _, dd = fleet_pair(
+            lambda: make_env("catch"), (10, 5, 1), flush=16, steps=160
+        )
+        tx = sum(c.transitions.action.shape[0] for c in dd)
+        fr = sum(c.transitions.frames.shape[0] for c in dd)
+        # The dedup win: ~1 frame per transition vs the double-store's 2.
+        assert fr / tx < 1.15, (fr, tx)
+
+    def test_dedup_requires_flush_at_least_n(self):
+        from ape_x_dqn_tpu.actors import ActorFleet
+        from ape_x_dqn_tpu.envs import ChainMDP
+        from ape_x_dqn_tpu.models.dueling import DuelingMLP
+
+        net = DuelingMLP(num_actions=2, hidden_sizes=(8,))
+        with pytest.raises(ValueError, match="dedup"):
+            ActorFleet([ChainMDP] * 2, net, n_step=4, flush_every=3,
+                       emit_dedup=True)
+
+
+def mirrored_buffers(capacity=64, frame_ratio=2.0):
+    dd = DedupReplay(capacity, OBS, sum_tree_cls=SumTree,
+                     frame_ratio=frame_ratio)
+    ds = PrioritizedReplay(capacity, OBS, sum_tree_cls=SumTree)
+    return dd, ds
+
+
+def feed_both(dd, ds, chunks, prio_rng):
+    """Feed the dedup stream to DedupReplay and its materialization to the
+    double-store; returns the per-chunk priorities used."""
+    prev_by_src = {}
+    for c in chunks:
+        p = (np.abs(prio_rng.normal(size=c.action.shape[0])) + 0.1)
+        i1 = dd.add(p, c)
+        i2 = ds.add(p, materialize_dedup(c, prev_by_src.get(c.source)))
+        np.testing.assert_array_equal(i1, i2)
+        prev_by_src[c.source] = c
+
+
+class TestStoreEquivalence:
+    def chunk_stream(self, n_chunks=40, n_tx=4):
+        """A contiguous single-source stream with cross-chunk carry."""
+        out = []
+        fbase = 0
+        prev_U = 0
+        for i in range(n_chunks):
+            carry = 2 if i else 0
+            c = make_chunk(11, i, fbase, n_tx=n_tx, carry=carry,
+                           prev_frames=prev_U, extras=(i % 3 == 2))
+            out.append(c)
+            fbase += c.frames.shape[0]
+            prev_U = c.frames.shape[0]
+        return out
+
+    def test_identical_samples_through_wrap(self):
+        dd, ds = mirrored_buffers(capacity=64)
+        # 40 chunks x ~5-6 rows ≈ 3-4x capacity: full FIFO wrap coverage.
+        feed_both(dd, ds, self.chunk_stream(), np.random.default_rng(0))
+        assert dd.size() == ds.size() == 64
+        assert dd.stats["frame_dead"] == 0, "ratio 2.0 must never early-kill"
+        for trial in range(5):
+            r1, r2 = (np.random.default_rng(trial), np.random.default_rng(trial))
+            b1 = dd.sample(16, beta=0.5, rng=r1)
+            b2 = ds.sample(16, beta=0.5, rng=r2)
+            np.testing.assert_array_equal(b1.indices, b2.indices)
+            np.testing.assert_allclose(b1.is_weights, b2.is_weights)
+            for f in ("obs", "action", "reward", "discount", "next_obs"):
+                np.testing.assert_array_equal(
+                    getattr(b1.transition, f), getattr(b2.transition, f), f
+                )
+            upd = np.abs(np.random.default_rng(100 + trial).normal(size=16)) + 0.05
+            dd.update_priorities(b1.indices, upd)
+            ds.update_priorities(b2.indices, upd)
+        assert dd.max_priority() == pytest.approx(ds.max_priority())
+
+    def test_memory_halves(self):
+        dd, ds = mirrored_buffers(capacity=64, frame_ratio=1.25)
+        assert dd.frames_nbytes() == pytest.approx(
+            0.625 * (ds._obs.nbytes() + ds._next_obs.nbytes()), rel=0.02
+        )
+
+
+class TestDedupEdges:
+    def test_frame_death_sweep_and_sample_consistency(self):
+        """An undersized frame ring must invalidate (not corrupt): dead
+        slots become unsampleable, and every sampled row's frames still
+        match its own insertion-time refs."""
+        rng = np.random.default_rng(3)
+        dd = DedupReplay(64, OBS, sum_tree_cls=SumTree, frame_ratio=0.5)
+        fbase, prev_U = 0, 0
+        for i in range(30):
+            c = make_chunk(5, i, fbase, n_tx=4, carry=2 if i else 0,
+                           prev_frames=prev_U)
+            dd.add(np.ones(c.action.shape[0]), c)
+            fbase += c.frames.shape[0]
+            prev_U = c.frames.shape[0]
+        assert dd.stats["frame_dead"] > 0
+        # Live mass only on frame-live rows; every sample's obs content
+        # equals the frame seq it references (frame() encodes seq).
+        for t in range(10):
+            b = dd.sample(8, rng=np.random.default_rng(t))
+            seqs = dd._obs_seq[b.indices]
+            nxt = dd._next_seq[b.indices]
+            fmin = dd._fcount - dd.frame_capacity
+            assert (seqs >= fmin).all(), "sampled a frame-dead transition"
+            np.testing.assert_array_equal(
+                b.transition.obs, np.stack([frame(s) for s in seqs])
+            )
+            np.testing.assert_array_equal(
+                b.transition.next_obs, np.stack([frame(s) for s in nxt])
+            )
+
+    def test_restamp_cannot_resurrect_dead_slot(self):
+        dd = DedupReplay(64, OBS, sum_tree_cls=SumTree, frame_ratio=0.5)
+        fbase, prev_U = 0, 0
+        first_idx = None
+        for i in range(30):
+            c = make_chunk(5, i, fbase, n_tx=4, carry=2 if i else 0,
+                           prev_frames=prev_U)
+            idx = dd.add(np.ones(c.action.shape[0]), c)
+            if first_idx is None:
+                first_idx = idx.copy()
+            fbase += c.frames.shape[0]
+            prev_U = c.frames.shape[0]
+        # Find a currently-dead slot and try to restamp it.
+        dead = np.nonzero(~dd._alive[: dd.size()])[0]
+        assert dead.size, "expected frame-dead slots at ratio 0.5"
+        before = dd._tree.get(dead[:1])[0]
+        dd.update_priorities(dead[:1], np.array([9.9]))
+        assert dd._tree.get(dead[:1])[0] == before == 0.0
+
+    def test_carry_gap_drops_only_carried_rows(self):
+        dd = DedupReplay(64, OBS, sum_tree_cls=SumTree)
+        c0 = make_chunk(7, 0, 0, n_tx=4)
+        dd.add(np.ones(4), c0)
+        # chunk_seq jumps 0 -> 2: the 2 carry rows must drop, the rest land.
+        c2 = make_chunk(7, 2, c0.frames.shape[0], n_tx=4, carry=2,
+                        prev_frames=c0.frames.shape[0])
+        idx = dd.add(np.ones(6), c2)
+        assert len(idx) == 4
+        assert dd.stats["dropped_carry"] == 2
+        assert dd.size() == 8
+        # An unknown source with carry refs drops them too.
+        c_alien = make_chunk(99, 5, 40, n_tx=3, carry=1, prev_frames=17)
+        idx = dd.add(np.ones(4), c_alien)
+        assert len(idx) == 3
+        assert dd.stats["dropped_carry"] == 3
+
+    def test_checkpoint_roundtrip_wrapped_ring(self):
+        dd = DedupReplay(32, OBS, sum_tree_cls=SumTree, frame_ratio=1.5)
+        fbase, prev_U = 0, 0
+        for i in range(25):
+            c = make_chunk(5, i, fbase, n_tx=4, carry=2 if i else 0,
+                           prev_frames=prev_U)
+            dd.add(np.full(c.action.shape[0], 0.3 + 0.01 * i), c)
+            fbase += c.frames.shape[0]
+            prev_U = c.frames.shape[0]
+        snap = dd.state_dict()
+        # npz-roundtrip the snapshot like the checkpoint layer does.
+        import io
+
+        buf = io.BytesIO()
+        np.savez(buf, **snap)
+        buf.seek(0)
+        with np.load(buf) as z:
+            snap = {k: z[k] for k in z.files}
+        dd2 = DedupReplay(32, OBS, sum_tree_cls=SumTree, frame_ratio=1.5)
+        dd2.load_state_dict(snap)
+        b1 = dd.sample(16, rng=np.random.default_rng(5))
+        b2 = dd2.sample(16, rng=np.random.default_rng(5))
+        np.testing.assert_array_equal(b1.indices, b2.indices)
+        for f in ("obs", "action", "reward", "discount", "next_obs"):
+            np.testing.assert_array_equal(
+                getattr(b1.transition, f), getattr(b2.transition, f), f
+            )
+        # A CONTINUING source resumes carry across the restore.
+        c = make_chunk(5, 25, fbase, n_tx=4, carry=2, prev_frames=prev_U)
+        idx = dd2.add(np.ones(6), c)
+        assert len(idx) == 6 and dd2.stats["dropped_carry"] == 0
+
+    def test_frame_capacity_mismatch_rejected(self):
+        dd = DedupReplay(32, OBS, sum_tree_cls=SumTree, frame_ratio=1.5)
+        dd.add(np.ones(4), make_chunk(5, 0, 0, n_tx=4))
+        snap = dd.state_dict()
+        other = DedupReplay(32, OBS, sum_tree_cls=SumTree, frame_ratio=2.0)
+        with pytest.raises(ValueError, match="frame ring"):
+            other.load_state_dict(snap)
+        ds_style = PrioritizedReplay(32, OBS, sum_tree_cls=SumTree)
+        with pytest.raises(ValueError, match="dedup"):
+            dd.load_state_dict(ds_style.state_dict())
