@@ -1,0 +1,377 @@
+// Native frame-dedup prioritized replay core — the paper-scale host path.
+//
+// Round-4 verdict item 1b: the pure-Python host replay measured ~4.3k
+// sample+update pairs/s at 2M slots on this image's one core — below the
+// single-chip fused learner rate, so config-scale host buffers could not
+// feed the learner.  The costs are (a) Python call overhead per stage,
+// (b) the frame gather's per-row fancy-indexing, (c) the sum-tree's
+// ctypes round trips.  This core fuses each learner-facing operation into
+// ONE C call (ctypes releases the GIL for the duration):
+//
+//   rc_add:    frame-ring write + transition write + priority set +
+//              liveness sweep (obs_seq aged out -> mass 0), one pass;
+//   rc_sample: stratified inverse-CDF descent + IS weights + BOTH frame
+//              gathers (memcpy per row) into caller buffers;
+//   rc_update: liveness-guarded priority restamp.
+//
+// The sum-tree is STRIPED K ways (slot i -> stripe i % K) with a mutex
+// per stripe.  The striped sampling law matches the sharded device
+// replay exactly — equal rows per stripe, proportional within,
+// q_i = (m_i / M_s) / K — with the IS weights computed for that realized
+// law (replay/device.py:137-145 is the same correction on TPU shards),
+// so a run can move between host stripes and device shards without
+// changing the estimator.  NOTE: the Python wrapper currently serializes
+// calls under one lock (its carry-resolver state is Python-side), so the
+// per-stripe mutexes are lock-granularity groundwork, not realized
+// multicore parallelism — this 1-core image could not demonstrate it
+// either way; bench sections label the striped numbers accordingly.
+// n_stripes=1 reduces bit-for-bit to the numpy DedupReplay (the oracle:
+// tests/test_native_dedup.py).
+//
+// The frame ring is mmap'd with MADV_HUGEPAGE: a 2M x 7KB ring spans
+// ~17 GB, and 4 KB TLB entries miss constantly under random gather; 2 MB
+// transparent hugepages cut the page-walk tax (measured in BENCH host
+// sections).
+//
+// Semantics contract (kept identical to replay/dedup.py — the Python
+// wrapper replay/native_dedup.py shares the numpy twin's ref-resolution
+// and tests pin parity): frame seqs are int64 (no wrap games host-side),
+// obs_seq is each row's oldest ref, dead slots never resurrect.
+//
+// Build: g++ -O3 -shared -fPIC (replay/native_dedup.py, cached .so).
+
+#include <sys/mman.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Stripe {
+  int64_t leaf_base = 1;           // pow2 >= leaf count
+  std::vector<double> tree;        // 2 * leaf_base nodes, tree[1] = total
+  std::mutex mu;
+};
+
+struct Core {
+  int64_t capacity = 0;            // transition slots
+  int64_t frame_capacity = 0;      // frame slots
+  int64_t frame_bytes = 0;         // bytes per frame
+  double alpha = 0.6;
+  int n_stripes = 1;
+
+  uint8_t* frames = nullptr;       // mmap'd, frame_capacity * frame_bytes
+  size_t frames_len = 0;
+  std::vector<int64_t> obs_seq, next_seq;
+  std::vector<int32_t> action;
+  std::vector<float> reward, discount;
+  std::vector<uint8_t> alive;
+
+  int64_t cursor = 0;              // transition ring position
+  int64_t count = 0;               // transitions ever accepted
+  int64_t fcount = 0;              // frames ever written
+  int64_t frame_dead = 0;          // sweep-invalidated rows (stat)
+  std::vector<Stripe> stripes;
+};
+
+int64_t next_pow2(int64_t n) {
+  int64_t p = 1;
+  while (p < n) p <<= 1;
+  return p;
+}
+
+// ---- striped sum-tree ------------------------------------------------
+
+inline int stripe_of(const Core& c, int64_t slot) {
+  return static_cast<int>(slot % c.n_stripes);
+}
+inline int64_t leaf_of(const Core& c, int64_t slot) {
+  return slot / c.n_stripes;
+}
+
+void tree_set_one(Stripe& s, int64_t leaf, double v) {
+  int64_t node = s.leaf_base + leaf;
+  s.tree[node] = v;
+  for (node >>= 1; node >= 1; node >>= 1)
+    s.tree[node] = s.tree[2 * node] + s.tree[2 * node + 1];
+}
+
+int64_t tree_descend(const Stripe& s, double target) {
+  int64_t node = 1;
+  while (node < s.leaf_base) {
+    double left = s.tree[2 * node];
+    if (target < left) {
+      node = 2 * node;
+    } else {
+      target -= left;
+      node = 2 * node + 1;
+    }
+  }
+  return node - s.leaf_base;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rc_create(int64_t capacity, int64_t frame_capacity,
+                int64_t frame_bytes, double alpha, int n_stripes) {
+  if (capacity <= 0 || frame_capacity <= 0 || frame_bytes <= 0 ||
+      n_stripes <= 0)
+    return nullptr;
+  Core* c = new (std::nothrow) Core();
+  if (!c) return nullptr;
+  c->capacity = capacity;
+  c->frame_capacity = frame_capacity;
+  c->frame_bytes = frame_bytes;
+  c->alpha = alpha;
+  c->n_stripes = n_stripes;
+  c->frames_len = static_cast<size_t>(frame_capacity) * frame_bytes;
+  void* mem = mmap(nullptr, c->frames_len, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (mem == MAP_FAILED) {
+    delete c;
+    return nullptr;
+  }
+  // 2 MB transparent hugepages for the gather-heavy frame ring.
+  madvise(mem, c->frames_len, MADV_HUGEPAGE);
+  c->frames = static_cast<uint8_t*>(mem);
+  c->obs_seq.assign(capacity, 0);
+  c->next_seq.assign(capacity, 0);
+  c->action.assign(capacity, 0);
+  c->reward.assign(capacity, 0.f);
+  c->discount.assign(capacity, 0.f);
+  c->alive.assign(capacity, 0);
+  c->stripes = std::vector<Stripe>(n_stripes);
+  for (int s = 0; s < n_stripes; ++s) {
+    int64_t leaves = (capacity - s + n_stripes - 1) / n_stripes;
+    c->stripes[s].leaf_base = next_pow2(std::max<int64_t>(leaves, 1));
+    c->stripes[s].tree.assign(2 * c->stripes[s].leaf_base, 0.0);
+  }
+  return c;
+}
+
+void rc_destroy(void* h) {
+  Core* c = static_cast<Core*>(h);
+  if (!c) return;
+  if (c->frames) munmap(c->frames, c->frames_len);
+  delete c;
+}
+
+int64_t rc_size(void* h) {
+  Core* c = static_cast<Core*>(h);
+  return std::min(c->count, c->capacity);
+}
+int64_t rc_count(void* h) { return static_cast<Core*>(h)->count; }
+int64_t rc_fcount(void* h) { return static_cast<Core*>(h)->fcount; }
+int64_t rc_cursor(void* h) { return static_cast<Core*>(h)->cursor; }
+int64_t rc_frame_dead(void* h) { return static_cast<Core*>(h)->frame_dead; }
+
+double rc_total(void* h) {
+  Core* c = static_cast<Core*>(h);
+  double t = 0;
+  for (auto& s : c->stripes) t += s.tree[1];
+  return t;
+}
+
+double rc_max(void* h) {
+  Core* c = static_cast<Core*>(h);
+  double m = 0;
+  for (auto& s : c->stripes)
+    for (int64_t i = s.leaf_base; i < 2 * s.leaf_base; ++i)
+      m = std::max(m, s.tree[i]);
+  return m;
+}
+
+// Ingest one chunk: U frames + M transitions with pre-resolved absolute
+// refs, then the liveness sweep.  Returns the first transition slot
+// written (ring order), or -1 on a size violation.
+int64_t rc_add(void* h, int64_t U, const uint8_t* frames, int64_t M,
+               const int64_t* obs_seq, const int64_t* next_seq,
+               const int32_t* action, const float* reward,
+               const float* discount, const float* prio) {
+  Core* c = static_cast<Core*>(h);
+  if (U > c->frame_capacity || M > c->capacity) return -1;
+  // Frame-ring write (seq-addressed slots; U <= Cf so at most one wrap).
+  int64_t fslot = c->fcount % c->frame_capacity;
+  int64_t first = std::min(U, c->frame_capacity - fslot);
+  std::memcpy(c->frames + fslot * c->frame_bytes, frames,
+              static_cast<size_t>(first) * c->frame_bytes);
+  if (first < U)
+    std::memcpy(c->frames, frames + first * c->frame_bytes,
+                static_cast<size_t>(U - first) * c->frame_bytes);
+  c->fcount += U;
+  // Transition ring write + priority set (stripe-locked per row batch).
+  int64_t base = c->cursor;
+  for (int64_t i = 0; i < M; ++i) {
+    int64_t slot = (base + i) % c->capacity;
+    c->obs_seq[slot] = obs_seq[i];
+    c->next_seq[slot] = next_seq[i];
+    c->action[slot] = action[i];
+    c->reward[slot] = reward[i];
+    c->discount[slot] = discount[i];
+    c->alive[slot] = 1;
+    double p = std::pow(std::max(static_cast<double>(prio[i]), 1e-12),
+                        c->alpha);
+    Stripe& s = c->stripes[stripe_of(*c, slot)];
+    std::lock_guard<std::mutex> g(s.mu);
+    tree_set_one(s, leaf_of(*c, slot), p);
+  }
+  c->cursor = (base + M) % c->capacity;
+  c->count += M;
+  // Liveness sweep: rows whose obs frame was overwritten lose their mass.
+  int64_t fmin = c->fcount - c->frame_capacity;
+  if (fmin > 0) {
+    int64_t size = std::min(c->count, c->capacity);
+    for (int64_t slot = 0; slot < size; ++slot) {
+      if (c->alive[slot] && c->obs_seq[slot] < fmin) {
+        c->alive[slot] = 0;
+        ++c->frame_dead;
+        Stripe& s = c->stripes[stripe_of(*c, slot)];
+        std::lock_guard<std::mutex> g(s.mu);
+        tree_set_one(s, leaf_of(*c, slot), 0.0);
+      }
+    }
+  }
+  return base;
+}
+
+// Stratified PER sample: B rows (B % n_stripes == 0; B/K per stripe, the
+// striped law), gathering both frames and computing IS weights in one
+// GIL-released call.  `u` supplies B uniforms (RNG stays in Python so the
+// numpy twin is a bit-exact oracle at n_stripes=1).
+// Returns 0 ok, -1 empty, -2 B not divisible by stripes.
+int32_t rc_sample(void* h, int64_t B, double beta, const double* u,
+                  int64_t* out_idx, double* out_weights, uint8_t* out_obs,
+                  uint8_t* out_next, int32_t* out_action, float* out_reward,
+                  float* out_discount) {
+  Core* c = static_cast<Core*>(h);
+  if (B % c->n_stripes) return -2;
+  int64_t size = std::min(c->count, c->capacity);
+  if (size == 0) return -1;
+  int64_t Bk = B / c->n_stripes;
+  double wmax = 0.0;
+  for (int s_i = 0; s_i < c->n_stripes; ++s_i) {
+    Stripe& s = c->stripes[s_i];
+    std::lock_guard<std::mutex> g(s.mu);
+    double total = s.tree[1];
+    if (total <= 0) return -1;  // a populated core never has an empty stripe
+    double bounds = total / Bk;
+    double clip = std::nextafter(total, 0.0);
+    for (int64_t j = 0; j < Bk; ++j) {
+      double target = (j + u[s_i * Bk + j]) * bounds;
+      target = std::min(std::max(target, 0.0), clip);
+      int64_t leaf = tree_descend(s, target);
+      int64_t slot = leaf * c->n_stripes + s_i;
+      if (slot >= c->capacity) slot = c->capacity - 1 - ((c->capacity - 1 - s_i) % c->n_stripes);
+      int64_t k = s_i * Bk + j;
+      out_idx[k] = slot;
+      double mass = s.tree[s.leaf_base + leaf_of(*c, slot)];
+      // Realized law: equal rows per stripe, proportional within —
+      // q = (mass / total_s) / K; w = (N * q)^-beta.  The guard sits on
+      // the within-stripe probability so n_stripes=1 is BIT-exact with
+      // the numpy twin's size * max(probs, 1e-12) spelling.
+      double q0 = std::max(mass / total, 1e-12);
+      double w = std::pow(static_cast<double>(size) * q0 / c->n_stripes,
+                          -beta);
+      out_weights[k] = w;
+      if (w > wmax) wmax = w;
+    }
+  }
+  for (int64_t k = 0; k < B; ++k) {
+    out_weights[k] /= wmax;
+    int64_t slot = out_idx[k];
+    int64_t of = c->obs_seq[slot] % c->frame_capacity;
+    int64_t nf = c->next_seq[slot] % c->frame_capacity;
+    std::memcpy(out_obs + k * c->frame_bytes,
+                c->frames + of * c->frame_bytes, c->frame_bytes);
+    std::memcpy(out_next + k * c->frame_bytes,
+                c->frames + nf * c->frame_bytes, c->frame_bytes);
+    out_action[k] = c->action[slot];
+    out_reward[k] = c->reward[slot];
+    out_discount[k] = c->discount[slot];
+  }
+  return 0;
+}
+
+// Liveness-guarded priority restamp (last write wins within the batch).
+void rc_update(void* h, int64_t n, const int64_t* idx, const float* prio) {
+  Core* c = static_cast<Core*>(h);
+  int64_t fmin = c->fcount - c->frame_capacity;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = idx[i];
+    if (slot < 0 || slot >= c->capacity) continue;
+    if (!c->alive[slot] || c->obs_seq[slot] < fmin) continue;
+    double p = std::pow(std::max(static_cast<double>(prio[i]), 1e-12),
+                        c->alpha);
+    Stripe& s = c->stripes[stripe_of(*c, slot)];
+    std::lock_guard<std::mutex> g(s.mu);
+    tree_set_one(s, leaf_of(*c, slot), p);
+  }
+}
+
+double rc_get_mass(void* h, int64_t slot) {
+  Core* c = static_cast<Core*>(h);
+  if (slot < 0 || slot >= c->capacity) return -1.0;
+  Stripe& s = c->stripes[stripe_of(*c, slot)];
+  return s.tree[s.leaf_base + leaf_of(*c, slot)];
+}
+
+// ---- snapshot (checkpointing) ---------------------------------------
+
+// Copy state into caller-provided buffers sized by the counters above:
+// frames [min(fcount, Cf) * frame_bytes] slot-ordered, per-slot arrays
+// [size], masses [size].
+void rc_export(void* h, uint8_t* frames, int64_t* obs_seq,
+               int64_t* next_seq, int32_t* action, float* reward,
+               float* discount, uint8_t* alive, double* mass) {
+  Core* c = static_cast<Core*>(h);
+  int64_t nf = std::min(c->fcount, c->frame_capacity);
+  std::memcpy(frames, c->frames, static_cast<size_t>(nf) * c->frame_bytes);
+  int64_t size = std::min(c->count, c->capacity);
+  std::memcpy(obs_seq, c->obs_seq.data(), size * sizeof(int64_t));
+  std::memcpy(next_seq, c->next_seq.data(), size * sizeof(int64_t));
+  std::memcpy(action, c->action.data(), size * sizeof(int32_t));
+  std::memcpy(reward, c->reward.data(), size * sizeof(float));
+  std::memcpy(discount, c->discount.data(), size * sizeof(float));
+  std::memcpy(alive, c->alive.data(), size * sizeof(uint8_t));
+  for (int64_t slot = 0; slot < size; ++slot)
+    mass[slot] = rc_get_mass(h, slot);
+}
+
+// Restore from a snapshot (sizes must match the live core's config).
+// Returns 0 ok, -1 on size violation.
+int32_t rc_import(void* h, int64_t nf, const uint8_t* frames, int64_t size,
+                  const int64_t* obs_seq, const int64_t* next_seq,
+                  const int32_t* action, const float* reward,
+                  const float* discount, const uint8_t* alive,
+                  const double* mass, int64_t cursor, int64_t count,
+                  int64_t fcount) {
+  Core* c = static_cast<Core*>(h);
+  if (nf > c->frame_capacity || size > c->capacity) return -1;
+  std::memcpy(c->frames, frames, static_cast<size_t>(nf) * c->frame_bytes);
+  for (auto& s : c->stripes)
+    std::fill(s.tree.begin(), s.tree.end(), 0.0);
+  std::fill(c->alive.begin(), c->alive.end(), 0);
+  std::memcpy(c->obs_seq.data(), obs_seq, size * sizeof(int64_t));
+  std::memcpy(c->next_seq.data(), next_seq, size * sizeof(int64_t));
+  std::memcpy(c->action.data(), action, size * sizeof(int32_t));
+  std::memcpy(c->reward.data(), reward, size * sizeof(float));
+  std::memcpy(c->discount.data(), discount, size * sizeof(float));
+  std::memcpy(c->alive.data(), alive, size * sizeof(uint8_t));
+  for (int64_t slot = 0; slot < size; ++slot) {
+    Stripe& s = c->stripes[stripe_of(*c, slot)];
+    tree_set_one(s, leaf_of(*c, slot), mass[slot]);
+  }
+  c->cursor = cursor % c->capacity;
+  c->count = count;
+  c->fcount = fcount;
+  return 0;
+}
+
+}  // extern "C"

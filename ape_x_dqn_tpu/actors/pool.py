@@ -121,6 +121,7 @@ class ActorFleet:
         epsilon_total: int | None = None,
         emission: str = "overlapping",
         emit_dedup: bool = False,
+        emit_dedup_groups: int = 1,
     ):
         self.envs = SyncVectorEnv(env_fns)
         self.network = network
@@ -180,17 +181,35 @@ class ActorFleet:
         self._step_count = 0    # total fleet steps
         self.params = None
         self.param_version = -1
-        # Dedup emission state (types.DedupChunk): a fresh random source id
+        # Dedup emission state (types.DedupChunk): fresh random source ids
         # per fleet INSTANCE — a respawned worker's new fleet bootstraps a
         # self-contained first chunk, so consumers never resolve carry refs
-        # across an incarnation gap.
+        # across an incarnation gap.  ``emit_dedup_groups`` splits the
+        # fleet's actors into that many INDEPENDENT dedup streams (one
+        # source each): the sharded dedup ring routes whole sources to
+        # shards, so a single fleet must present >= n_shards sources or
+        # some shards would starve (runtime/fused_dedup.DedupStager).
         self.emit_dedup = bool(emit_dedup)
+        g = int(emit_dedup_groups)
+        if g < 1:
+            raise ValueError("emit_dedup_groups must be >= 1")
+        if g > 1 and not emit_dedup:
+            raise ValueError("emit_dedup_groups requires emit_dedup=True")
+        if g > N:
+            raise ValueError(
+                f"emit_dedup_groups {g} exceeds the fleet's {N} actors"
+            )
         import os as _os
 
-        self._source = int.from_bytes(_os.urandom(8), "little") >> 1
-        self._chunk_seq = 0
-        self._last_U = 0        # previous chunk's total frame count
-        self._last_bw = 0       # previous chunk's base window row
+        self._groups = g
+        # Group b owns actor columns [bounds[b], bounds[b+1]).
+        self._group_bounds = [round(b * N / g) for b in range(g + 1)]
+        self._source = [
+            int.from_bytes(_os.urandom(8), "little") >> 1 for _ in range(g)
+        ]
+        self._chunk_seq = [0] * g
+        self._last_U = [0] * g   # previous chunk's total frame count
+        self._last_bw = [0] * g  # previous chunk's base window row
 
     @property
     def num_actors(self) -> int:
@@ -233,8 +252,10 @@ class ActorFleet:
                 self._hist_trunc_obs[slot][trunc] = final_obs[trunc]
         self._rows = min(self._rows + 1, self._H)
 
-    def _flush(self) -> Chunk:
-        """Emit n-step transitions per actor from the history ring —
+    def _flush(self) -> List[Chunk]:
+        """Emit n-step transitions per actor from the history ring (one
+        chunk; ``emit_dedup_groups`` > 1 emits one DedupChunk per actor
+        group) —
         window starts 0..F-1 of the flush frame (all of them overlapping
         at stride 1; the GLOBALLY n-aligned subset at stride n, the
         reference's non-overlapping emission).  Requires a full ring
@@ -292,69 +313,75 @@ class ActorFleet:
         # Actor priority rule: |n-step TD error| with max-Q bootstrap
         # (reference actor.py:138-142), per transition (not collapsed).
         td = returns + boot * boot_qmax - qtaken
-        priorities = np.abs(td).astype(np.float32).reshape(-1)
-        action = self._hist_action[order[starts]].reshape(-1)
-        reward = returns.reshape(-1).astype(np.float32)
-        discount = boot.reshape(-1).astype(np.float32)
+        priorities = np.abs(td).astype(np.float32)          # [S, N]
+        action = self._hist_action[order[starts]]           # [S, N]
+        reward = returns.astype(np.float32)
+        discount = boot.astype(np.float32)
         if self.emit_dedup:
-            transitions = self._build_dedup(
-                order, starts, trunc_k, action, reward, discount
-            )
-        else:
-            obs = self._hist_obs[order[starts]]            # [S, N, *obs]
-            next_obs = self._hist_obs[next_idx]            # [S, N, *obs]
-            for k in range(n):
-                m = trunc_k == k
-                if m.any():
-                    next_obs[m] = self._hist_trunc_obs[order[starts + k]][m]
-            transitions = NStepTransition(
-                obs=obs.reshape(S * N, *obs.shape[2:]),
-                action=action,
-                reward=reward,
-                discount=discount,
-                next_obs=next_obs.reshape(S * N, *next_obs.shape[2:]),
-            )
-        return Chunk(priorities, transitions, F * N)
+            return [
+                self._build_dedup(
+                    g, order, starts, trunc_k, priorities, action, reward,
+                    discount,
+                )
+                for g in range(self._groups)
+            ]
+        obs = self._hist_obs[order[starts]]            # [S, N, *obs]
+        next_obs = self._hist_obs[next_idx]            # [S, N, *obs]
+        for k in range(n):
+            m = trunc_k == k
+            if m.any():
+                next_obs[m] = self._hist_trunc_obs[order[starts + k]][m]
+        transitions = NStepTransition(
+            obs=obs.reshape(S * N, *obs.shape[2:]),
+            action=action.reshape(-1),
+            reward=reward.reshape(-1),
+            discount=discount.reshape(-1),
+            next_obs=next_obs.reshape(S * N, *next_obs.shape[2:]),
+        )
+        return [Chunk(priorities.reshape(-1), transitions, F * N)]
 
-    def _build_dedup(self, order, starts, trunc_k, action, reward, discount
-                     ) -> DedupChunk:
-        """Assemble the frame-dedup wire format (types.DedupChunk) for this
-        flush: ship only the F NEW step rows (all H on the bootstrap flush)
-        plus truncation extras; windows overlapping the previous flush
-        carry negative refs into its tail."""
-        n, F, N = self.n_step, self.flush_every, self.num_actors
+    def _build_dedup(self, g, order, starts, trunc_k, priorities, action,
+                     reward, discount) -> Chunk:
+        """Assemble group ``g``'s frame-dedup chunk (types.DedupChunk):
+        ship only the F NEW step rows for this group's actor columns (all
+        H on the group's bootstrap flush) plus truncation extras; windows
+        overlapping the previous flush carry negative refs into its tail."""
+        n, F = self.n_step, self.flush_every
         H = self._H
-        bw = 0 if self._chunk_seq == 0 else n   # first NEW window row
-        rows = order[bw:H]                       # new step rows, oldest→newest
-        step_frames = self._hist_obs[rows]       # [H-bw, N, *obs]
+        a0, a1 = self._group_bounds[g], self._group_bounds[g + 1]
+        Ng = a1 - a0
+        bw = 0 if self._chunk_seq[g] == 0 else n  # first NEW window row
+        rows = order[bw:H]                        # new step rows, old→new
+        step_frames = self._hist_obs[rows][:, a0:a1]   # [H-bw, Ng, *obs]
         obs_shape = step_frames.shape[2:]
         S = len(starts)
-        a_grid = np.broadcast_to(np.arange(N), (S, N))
-        s_grid = np.broadcast_to(starts[:, None], (S, N))
+        a_grid = np.broadcast_to(np.arange(Ng), (S, Ng))
+        s_grid = np.broadcast_to(starts[:, None], (S, Ng))
         in_chunk = s_grid >= bw
         obs_ref = np.where(
             in_chunk,
-            (s_grid - bw) * N + a_grid,
+            (s_grid - bw) * Ng + a_grid,
             # Carry: window row σ (< bw = n) was the previous chunk's
-            # window row σ + F, at its step index (σ + F − prev_bw)·N + a;
+            # window row σ + F, at its step index (σ + F − prev_bw)·Ng + a;
             # negative refs are relative to the previous chunk's END.
-            (s_grid + F - self._last_bw) * N + a_grid - self._last_U,
+            (s_grid + F - self._last_bw[g]) * Ng + a_grid - self._last_U[g],
         ).astype(np.int64)
-        next_ref = ((s_grid + n - bw) * N + a_grid).astype(np.int64)
+        next_ref = ((s_grid + n - bw) * Ng + a_grid).astype(np.int64)
+        tk = trunc_k[:, a0:a1]
         extras = []
         extra_index: dict = {}
-        if (trunc_k >= 0).any():
-            for j, a in zip(*np.nonzero(trunc_k >= 0)):
-                k = int(trunc_k[j, a])
-                t_row = int(starts[j] + k)       # window row of the trunc
+        if (tk >= 0).any():
+            for j, a in zip(*np.nonzero(tk >= 0)):
+                k = int(tk[j, a])
+                t_row = int(starts[j] + k)        # window row of the trunc
                 key = (t_row, int(a))
                 if key not in extra_index:
                     extra_index[key] = len(extras)
                     extras.append(
-                        self._hist_trunc_obs[order[t_row]][a]
+                        self._hist_trunc_obs[order[t_row]][a0 + a]
                     )
-                next_ref[j, a] = (H - bw) * N + extra_index[key]
-        U_step = (H - bw) * N
+                next_ref[j, a] = (H - bw) * Ng + extra_index[key]
+        U_step = (H - bw) * Ng
         frames = step_frames.reshape(U_step, *obs_shape)
         if extras:
             frames = np.concatenate([frames, np.stack(extras)], axis=0)
@@ -362,17 +389,19 @@ class ActorFleet:
             frames=frames,
             obs_ref=obs_ref.reshape(-1).astype(np.int32),
             next_ref=next_ref.reshape(-1).astype(np.int32),
-            action=action,
-            reward=reward,
-            discount=discount,
-            source=self._source,
-            chunk_seq=self._chunk_seq,
-            prev_frames=self._last_U,
+            action=action[:, a0:a1].reshape(-1),
+            reward=reward[:, a0:a1].reshape(-1),
+            discount=discount[:, a0:a1].reshape(-1),
+            source=self._source[g],
+            chunk_seq=self._chunk_seq[g],
+            prev_frames=self._last_U[g],
         )
-        self._chunk_seq += 1
-        self._last_U = frames.shape[0]
-        self._last_bw = bw
-        return chunk
+        self._chunk_seq[g] += 1
+        self._last_U[g] = frames.shape[0]
+        self._last_bw[g] = bw
+        return Chunk(
+            priorities[:, a0:a1].reshape(-1), chunk, F * Ng
+        )
 
     def collect(
         self,
@@ -432,7 +461,7 @@ class ActorFleet:
                 self._rows == self._H
                 and (self._step_count - self._H) % self.flush_every == 0
             ):
-                chunks.append(self._flush())
+                chunks.extend(self._flush())
             if param_source is not None and self._step_count % self.sync_every == 0:
                 self.sync_params(param_source)
         return chunks, stats

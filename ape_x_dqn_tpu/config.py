@@ -143,6 +143,15 @@ class ReplayConfig:
     # README TODO, reference README.md:24) — a memory/CPU trade for big
     # buffers; no effect on the HBM device replay (learner.device_replay).
     frame_compression: bool = False
+    # Frame-dedup storage (types.DedupChunk): actors ship each frame once
+    # and the replay (host DedupReplay or the HBM dedup ring) stores a
+    # single frame ring + per-transition refs — ~frame_ratio/2 of the
+    # double-store's footprint end to end.  frame_ratio sizes the frame
+    # ring per transition slot; it must cover the emission's arrival ratio
+    # (≈ (flush_every + n) / flush_every + truncation extras) or the
+    # oldest transitions become unsampleable early (gracefully).
+    dedup: bool = False
+    frame_ratio: float = 1.25
 
 
 @dataclasses.dataclass
@@ -183,6 +192,22 @@ class ApexConfig:
              "learner.min_replay_mem_size must be <= replay.capacity"),
             (0.0 <= r.priority_exponent <= 1.0,
              "replay.priority_exponent must be in [0, 1]"),
+            (not r.dedup or a.flush_every >= a.num_steps,
+             "replay.dedup requires actor.flush_every >= actor.num_steps "
+             "(DedupChunk carry refs reach at most one chunk back)"),
+            (not (r.dedup and r.frame_compression),
+             "replay.dedup and replay.frame_compression are mutually "
+             "exclusive (the dedup frame ring stores raw uint8)"),
+            # Sharded dedup rings route whole sources (per-fleet dedup
+            # streams) to shards; every fleet splits into data_parallel
+            # groups, so it needs at least that many actors.
+            (not (r.dedup and l.device_replay and l.data_parallel > 1)
+             or (a.num_actors if a.mode == "thread"
+                 else a.num_actors // a.num_workers) >= l.data_parallel,
+             "replay.dedup with device_replay needs >= data_parallel "
+             "actors per fleet (per worker in process mode) — each fleet "
+             "splits into one dedup stream per ring shard"),
+            (r.frame_ratio > 0, "replay.frame_ratio must be positive"),
             (0.0 <= r.is_exponent <= 1.0, "replay.is_exponent must be in [0, 1]"),
             (self.network in ("conv", "nature", "mlp"),
              f"unknown network kind: {self.network}"),
@@ -342,6 +367,8 @@ def _from_native_json(data: dict) -> ApexConfig:
             setattr(cfg, key, sections[key](**value))
         elif key in ("network", "seed"):
             setattr(cfg, key, data[key])
+        elif key.startswith("_"):
+            pass  # "_comment" and friends: documentation, not config
         else:
             raise ValueError(f"unknown top-level config entry: {key}")
     return cfg.validate()

@@ -45,6 +45,65 @@ import numpy as np
 from ape_x_dqn_tpu.types import DedupChunk, NStepTransition, PrioritizedBatch
 
 
+class CarryResolver:
+    """Per-source ref resolution shared by every dedup consumer (numpy
+    DedupReplay below, the native core's wrapper, tests): maps a chunk's
+    relative refs to absolute frame seqs given the consumer's frame
+    counter, tracking (chunk_seq, base, U) per source; a continuity gap
+    drops only the carried rows."""
+
+    def __init__(self, max_sources: int = 4096):
+        self.sources: dict = {}   # src -> (chunk_seq, frame_base, U)
+        self.dropped_carry = 0
+        self._max_sources = max_sources
+
+    def resolve(self, chunk: DedupChunk, base: int):
+        """-> (obs_seq int64 [M], next_seq int64 [M], keep bool [M]);
+        ``base`` is the consumer's frame count where this chunk's frames
+        will land.  Updates the source record."""
+        prev = self.sources.get(chunk.source)
+        contiguous = (
+            prev is not None
+            and chunk.chunk_seq == prev[0] + 1
+            and chunk.prev_frames == prev[2]
+        )
+        obs_seq = base + np.asarray(chunk.obs_ref, np.int64)
+        next_seq = base + np.asarray(chunk.next_ref, np.int64)
+        neg = chunk.obs_ref < 0
+        if neg.any():
+            if contiguous:
+                obs_seq[neg] = prev[1] + prev[2] + chunk.obs_ref[neg]
+                keep = np.ones(len(obs_seq), bool)
+            else:
+                keep = ~neg
+                self.dropped_carry += int(neg.sum())
+        else:
+            keep = np.ones(len(obs_seq), bool)
+        self.sources[chunk.source] = (
+            chunk.chunk_seq, base, chunk.frames.shape[0]
+        )
+        if len(self.sources) > self._max_sources:
+            for key in sorted(
+                self.sources, key=lambda s: self.sources[s][1]
+            )[: len(self.sources) // 2]:
+                del self.sources[key]
+        return obs_seq, next_seq, keep
+
+    def state_arrays(self):
+        src = self.sources
+        return (
+            np.array(list(src.keys()), np.int64),
+            np.array([list(v) for v in src.values()], np.int64)
+            .reshape(len(src), 3),
+        )
+
+    def load_state_arrays(self, ids, rows):
+        self.sources = {
+            int(s): tuple(int(x) for x in row)
+            for s, row in zip(ids, rows)
+        }
+
+
 class DedupReplay:
     """Prioritized n-step transition store over a shared frame ring.
 
@@ -89,10 +148,8 @@ class DedupReplay:
         self._cursor = 0
         self._count = 0          # transitions ever accepted
         self._fcount = 0         # frames ever written (monotone seq)
-        # source -> (chunk_seq, frame_base, total_frames) of its last chunk.
-        self._sources: dict = {}
-        self._max_sources = 4096
-        self.stats = {"frame_dead": 0, "dropped_carry": 0}
+        self._resolver = CarryResolver()
+        self._frame_dead = 0
         self._lock = threading.Lock()
 
     # -- write path (actors / drain) ------------------------------------
@@ -118,38 +175,12 @@ class DedupReplay:
             )
         with self._lock:
             base = self._fcount
-            prev = self._sources.get(chunk.source)
-            contiguous = (
-                prev is not None
-                and chunk.chunk_seq == prev[0] + 1
-                and chunk.prev_frames == prev[2]
-            )
-            neg = chunk.obs_ref < 0
-            obs_seq = base + chunk.obs_ref.astype(np.int64)
-            if neg.any():
-                if contiguous:
-                    # prev chunk's frames end exactly at prev[1] + prev[2];
-                    # ref r < 0 names its frame prev_end + r.
-                    obs_seq[neg] = prev[1] + prev[2] + chunk.obs_ref[neg]
-                    keep = np.ones(M, bool)
-                else:
-                    keep = ~neg
-                    self.stats["dropped_carry"] += int(neg.sum())
-            else:
-                keep = np.ones(M, bool)
-            next_seq = base + chunk.next_ref.astype(np.int64)
+            obs_seq, next_seq, keep = self._resolver.resolve(chunk, base)
             # Frames land regardless of dropped rows (the NEXT chunk's
             # carry refs point into them).
             fidx = (base + np.arange(U)) % self.frame_capacity
             self._frames[fidx] = chunk.frames
             self._fcount = base + U
-            self._sources[chunk.source] = (chunk.chunk_seq, base, U)
-            if len(self._sources) > self._max_sources:
-                # Evict the stalest source records (dead fleets).
-                for key in sorted(
-                    self._sources, key=lambda s: self._sources[s][1]
-                )[: len(self._sources) // 2]:
-                    del self._sources[key]
             m = int(keep.sum())
             idx = np.zeros(0, np.int64)
             if m:
@@ -180,7 +211,7 @@ class DedupReplay:
             di = np.nonzero(dead)[0]
             self._tree.set(di, np.zeros(len(di)))
             self._alive[di] = False
-            self.stats["frame_dead"] += len(di)
+            self._frame_dead += len(di)
 
     # -- read path (learner) --------------------------------------------
 
@@ -240,6 +271,13 @@ class DedupReplay:
 
     # -- misc ------------------------------------------------------------
 
+    @property
+    def stats(self) -> dict:
+        return {
+            "frame_dead": self._frame_dead,
+            "dropped_carry": self._resolver.dropped_carry,
+        }
+
     def size(self) -> int:
         with self._lock:
             return min(self._count, self.capacity)
@@ -265,7 +303,7 @@ class DedupReplay:
             size = min(self._count, self.capacity)
             idx = np.arange(size)
             nf = min(self._fcount, self.frame_capacity)
-            src = self._sources
+            src_ids, src_state = self._resolver.state_arrays()
             return {
                 "dedup": np.asarray(True),
                 "frames": self._frames[:nf].copy(),
@@ -280,10 +318,8 @@ class DedupReplay:
                 "count": self._count,
                 "fcount": self._fcount,
                 "frame_capacity": self.frame_capacity,
-                "src_ids": np.array(list(src.keys()), np.int64),
-                "src_state": np.array(
-                    [list(v) for v in src.values()], np.int64
-                ).reshape(len(src), 3),
+                "src_ids": src_ids,
+                "src_state": src_state,
             }
 
     def load_state_dict(self, state: dict) -> None:
@@ -322,7 +358,6 @@ class DedupReplay:
             self._tree.set(rng, state["tree_priorities"])
             self._cursor = int(state["cursor"]) % self.capacity
             self._count = int(state["count"])
-            self._sources = {
-                int(s): tuple(int(x) for x in row)
-                for s, row in zip(state["src_ids"], state["src_state"])
-            }
+            self._resolver.load_state_arrays(
+                state["src_ids"], state["src_state"]
+            )

@@ -237,16 +237,22 @@ def fused_scan_body(
     target_sync_freq: int | None,
     sample_ahead: bool,
     axis_name: str | None = None,
+    sample_many_fn=None,
 ):
     """The K-step [sample → train → restamp] scan + hoisted target sync —
-    the ONE body shared by the single-device builder below and the sharded
+    the ONE body shared by the single-device builder below, the sharded
     builder (replay/device_dp.py, where it runs per shard inside shard_map
-    with ``axis_name="data"`` and a per-shard batch size)."""
+    with ``axis_name="data"`` and a per-shard batch size), and the
+    frame-dedup layouts (replay/device_dedup.py, which inject their
+    sampler via ``sample_many_fn``; restamp/update only touch ``.mass``,
+    which every layout carries)."""
     K, B = steps_per_call, batch_size
     step_before = train_state.step
+    if sample_many_fn is None:
+        sample_many_fn = device_replay_sample_many
 
     if sample_ahead:
-        batches = device_replay_sample_many(
+        batches = sample_many_fn(
             replay_state, rng, K, B, beta, axis_name
         )
 
@@ -263,7 +269,10 @@ def fused_scan_body(
 
         def body(carry, step_rng):
             t_state, r_state = carry
-            batch = device_replay_sample(r_state, step_rng, B, beta, axis_name)
+            batch = jax.tree_util.tree_map(
+                lambda a: a[0],
+                sample_many_fn(r_state, step_rng, 1, B, beta, axis_name),
+            )
             t_state, metrics = train_step_fn(t_state, batch)
             r_state = device_replay_update_priorities(
                 r_state, batch.indices, metrics.priorities, priority_exponent

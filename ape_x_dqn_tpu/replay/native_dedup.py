@@ -1,0 +1,336 @@
+"""ctypes bindings for the native frame-dedup replay core — the
+paper-scale host path (round-4 verdict item 1b).
+
+``NativeDedupReplay`` is a drop-in for ``replay.dedup.DedupReplay`` (same
+constructor surface + add/sample/update_priorities/size/state_dict), with
+every learner-facing operation fused into ONE GIL-released C call
+(_native/replay_core.cc): tree descent + IS weights + both frame gathers
+in ``rc_sample``; ring writes + priority set + liveness sweep in
+``rc_add``.  The sum-tree is striped ``n_stripes`` ways with per-stripe
+locks; the striped sampling law matches the sharded device replay's
+(equal rows per stripe, IS-corrected) so runs can move between host
+stripes and device shards without changing the estimator.  This wrapper
+serializes calls under one Python-side lock (carry state lives here), so
+striping is law + lock-granularity groundwork — NOT demonstrated
+multicore parallelism (this image has one core).  ``n_stripes=1`` is
+bit-exact with the numpy twin (tests/test_native_dedup.py pins it).
+
+Build discipline mirrors replay/native.py: compile on first use with g++,
+atomic rename, cached .so keyed by source mtime; ``native_dedup_available``
+gates callers to the numpy fallback when the toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ape_x_dqn_tpu.replay.dedup import CarryResolver
+from ape_x_dqn_tpu.types import DedupChunk, NStepTransition, PrioritizedBatch
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_HERE, "_native", "replay_core.cc")
+_SO = os.path.join(_HERE, "_native", "replay_core.so")
+
+_lib = None
+_lib_err: str | None = None
+_lock = threading.Lock()
+
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_f32p = ctypes.POINTER(ctypes.c_float)
+_f64p = ctypes.POINTER(ctypes.c_double)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _build() -> None:
+    tmp = f"{_SO}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.rename(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _load():
+    global _lib, _lib_err
+    with _lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+            lib.rc_create.restype = ctypes.c_void_p
+            lib.rc_create.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_double, ctypes.c_int32,
+            ]
+            lib.rc_destroy.argtypes = [ctypes.c_void_p]
+            for name in ("rc_size", "rc_count", "rc_fcount", "rc_cursor",
+                         "rc_frame_dead"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int64
+                fn.argtypes = [ctypes.c_void_p]
+            for name in ("rc_total", "rc_max"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_double
+                fn.argtypes = [ctypes.c_void_p]
+            lib.rc_get_mass.restype = ctypes.c_double
+            lib.rc_get_mass.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            lib.rc_add.restype = ctypes.c_int64
+            lib.rc_add.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, _u8p, ctypes.c_int64,
+                _i64p, _i64p, _i32p, _f32p, _f32p, _f32p,
+            ]
+            lib.rc_sample.restype = ctypes.c_int32
+            lib.rc_sample.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_double, _f64p,
+                _i64p, _f64p, _u8p, _u8p, _i32p, _f32p, _f32p,
+            ]
+            lib.rc_update.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, _i64p, _f32p,
+            ]
+            lib.rc_export.argtypes = [
+                ctypes.c_void_p, _u8p, _i64p, _i64p, _i32p, _f32p, _f32p,
+                _u8p, _f64p,
+            ]
+            lib.rc_import.restype = ctypes.c_int32
+            lib.rc_import.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, _u8p, ctypes.c_int64,
+                _i64p, _i64p, _i32p, _f32p, _f32p, _u8p, _f64p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ]
+            _lib = lib
+        except Exception as e:  # compiler missing, build/load failure
+            _lib_err = f"{type(e).__name__}: {e}"
+        return _lib
+
+
+def native_dedup_available() -> bool:
+    return _load() is not None
+
+
+def native_dedup_error() -> str | None:
+    _load()
+    return _lib_err
+
+
+def _p(a: np.ndarray, ptr_t):
+    return a.ctypes.data_as(ptr_t)
+
+
+class NativeDedupReplay:
+    """C++-core frame-dedup prioritized replay (interface of DedupReplay)."""
+
+    def __init__(
+        self,
+        capacity: int,
+        obs_shape,
+        priority_exponent: float = 0.6,
+        obs_dtype=np.uint8,
+        frame_ratio: float = 1.25,
+        n_stripes: int = 1,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native replay core unavailable: {_lib_err}")
+        if np.dtype(obs_dtype) != np.uint8:
+            raise ValueError("native dedup core stores uint8 frames")
+        self._lib = lib
+        self.capacity = int(capacity)
+        self.frame_capacity = max(1, int(round(capacity * frame_ratio)))
+        self.obs_shape = tuple(obs_shape)
+        self.frame_bytes = int(np.prod(self.obs_shape))
+        self.alpha = float(priority_exponent)
+        self.n_stripes = int(n_stripes)
+        self._handle = lib.rc_create(
+            self.capacity, self.frame_capacity, self.frame_bytes,
+            self.alpha, self.n_stripes,
+        )
+        if not self._handle:
+            raise MemoryError("rc_create failed")
+        self._resolver = CarryResolver()
+        self._lock = threading.Lock()
+
+    def __del__(self):
+        h = getattr(self, "_handle", None)
+        if h:
+            self._lib.rc_destroy(h)
+            self._handle = None
+
+    # -- write path ------------------------------------------------------
+
+    def add(self, priorities: np.ndarray, chunk: DedupChunk) -> np.ndarray:
+        prio = np.ascontiguousarray(priorities, np.float32)
+        frames = np.ascontiguousarray(chunk.frames, np.uint8)
+        U, M = frames.shape[0], prio.shape[0]
+        if M > self.capacity or U > self.frame_capacity:
+            raise ValueError("chunk exceeds ring capacity")
+        with self._lock:
+            base = int(self._lib.rc_fcount(self._handle))
+            obs_seq, next_seq, keep = self._resolver.resolve(chunk, base)
+            obs_seq = np.ascontiguousarray(obs_seq[keep])
+            next_seq = np.ascontiguousarray(next_seq[keep])
+            action = np.ascontiguousarray(chunk.action, np.int32)[keep]
+            reward = np.ascontiguousarray(chunk.reward, np.float32)[keep]
+            discount = np.ascontiguousarray(chunk.discount, np.float32)[keep]
+            pk = np.ascontiguousarray(prio[keep])
+            m = obs_seq.shape[0]
+            first = self._lib.rc_add(
+                self._handle, U, _p(frames, _u8p), m,
+                _p(obs_seq, _i64p), _p(next_seq, _i64p),
+                _p(action, _i32p), _p(reward, _f32p),
+                _p(discount, _f32p), _p(pk, _f32p),
+            )
+            if first < 0:
+                raise ValueError("rc_add rejected the chunk (size violation)")
+            return (first + np.arange(m, dtype=np.int64)) % self.capacity
+
+    # -- read path -------------------------------------------------------
+
+    def sample(
+        self,
+        batch_size: int,
+        beta: float = 0.4,
+        rng: Optional[np.random.Generator] = None,
+    ) -> PrioritizedBatch:
+        rng = rng or np.random.default_rng()
+        B = int(batch_size)
+        u = np.ascontiguousarray(rng.random(B))
+        idx = np.empty(B, np.int64)
+        weights = np.empty(B, np.float64)
+        obs = np.empty((B, *self.obs_shape), np.uint8)
+        next_obs = np.empty((B, *self.obs_shape), np.uint8)
+        action = np.empty(B, np.int32)
+        reward = np.empty(B, np.float32)
+        discount = np.empty(B, np.float32)
+        with self._lock:
+            rc = self._lib.rc_sample(
+                self._handle, B, float(beta), _p(u, _f64p),
+                _p(idx, _i64p), _p(weights, _f64p), _p(obs, _u8p),
+                _p(next_obs, _u8p), _p(action, _i32p),
+                _p(reward, _f32p), _p(discount, _f32p),
+            )
+        if rc == -1:
+            raise ValueError("cannot sample from an empty replay")
+        if rc == -2:
+            raise ValueError(
+                f"batch_size {B} must divide by n_stripes {self.n_stripes}"
+            )
+        return PrioritizedBatch(
+            transition=NStepTransition(
+                obs=obs, action=action, reward=reward,
+                discount=discount, next_obs=next_obs,
+            ),
+            indices=idx.astype(np.int32),
+            is_weights=weights.astype(np.float32),
+        )
+
+    def update_priorities(self, indices, priorities) -> None:
+        idx = np.ascontiguousarray(indices, np.int64)
+        prio = np.ascontiguousarray(priorities, np.float32)
+        if idx.size == 0:
+            return
+        with self._lock:
+            self._lib.rc_update(
+                self._handle, idx.shape[0], _p(idx, _i64p), _p(prio, _f32p)
+            )
+
+    # -- misc ------------------------------------------------------------
+
+    def size(self) -> int:
+        return int(self._lib.rc_size(self._handle))
+
+    @property
+    def total_added(self) -> int:
+        return int(self._lib.rc_count(self._handle))
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "frame_dead": int(self._lib.rc_frame_dead(self._handle)),
+            "dropped_carry": self._resolver.dropped_carry,
+        }
+
+    def frames_nbytes(self) -> int:
+        return self.frame_capacity * self.frame_bytes
+
+    def max_priority(self) -> float:
+        m = float(self._lib.rc_max(self._handle))
+        return float(m ** (1.0 / self.alpha)) if m > 0 else 1.0
+
+    # -- snapshot --------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            size = self.size()
+            nf = min(int(self._lib.rc_fcount(self._handle)),
+                     self.frame_capacity)
+            frames = np.empty((nf, *self.obs_shape), np.uint8)
+            obs_seq = np.empty(size, np.int64)
+            next_seq = np.empty(size, np.int64)
+            action = np.empty(size, np.int32)
+            reward = np.empty(size, np.float32)
+            discount = np.empty(size, np.float32)
+            alive = np.empty(size, np.uint8)
+            mass = np.empty(size, np.float64)
+            self._lib.rc_export(
+                self._handle, _p(frames, _u8p), _p(obs_seq, _i64p),
+                _p(next_seq, _i64p), _p(action, _i32p), _p(reward, _f32p),
+                _p(discount, _f32p), _p(alive, _u8p), _p(mass, _f64p),
+            )
+            src_ids, src_state = self._resolver.state_arrays()
+            return {
+                "dedup": np.asarray(True),
+                "frames": frames, "obs_seq": obs_seq, "next_seq": next_seq,
+                "action": action, "reward": reward, "discount": discount,
+                "alive": alive.astype(bool),
+                "tree_priorities": mass,
+                "cursor": int(self._lib.rc_cursor(self._handle)),
+                "count": self.total_added,
+                "fcount": int(self._lib.rc_fcount(self._handle)),
+                "frame_capacity": self.frame_capacity,
+                "src_ids": src_ids, "src_state": src_state,
+            }
+
+    def load_state_dict(self, state: dict) -> None:
+        if "dedup" not in state:
+            raise ValueError("snapshot is not a dedup-replay snapshot")
+        if int(state["frame_capacity"]) != self.frame_capacity:
+            raise ValueError(
+                f"snapshot frame ring {int(state['frame_capacity'])} != "
+                f"configured {self.frame_capacity}"
+            )
+        size = state["obs_seq"].shape[0]
+        if size > self.capacity:
+            raise ValueError("snapshot larger than capacity")
+        with self._lock:
+            frames = np.ascontiguousarray(state["frames"], np.uint8)
+            rc = self._lib.rc_import(
+                self._handle, frames.shape[0], _p(frames, _u8p), size,
+                _p(np.ascontiguousarray(state["obs_seq"], np.int64), _i64p),
+                _p(np.ascontiguousarray(state["next_seq"], np.int64), _i64p),
+                _p(np.ascontiguousarray(state["action"], np.int32), _i32p),
+                _p(np.ascontiguousarray(state["reward"], np.float32), _f32p),
+                _p(np.ascontiguousarray(state["discount"], np.float32), _f32p),
+                _p(np.ascontiguousarray(
+                    state["alive"], np.uint8), _u8p),
+                _p(np.ascontiguousarray(
+                    state["tree_priorities"], np.float64), _f64p),
+                int(state["cursor"]), int(state["count"]),
+                int(state["fcount"]),
+            )
+            if rc != 0:
+                raise ValueError("rc_import rejected the snapshot")
+            self._resolver.load_state_arrays(
+                state["src_ids"], state["src_state"]
+            )

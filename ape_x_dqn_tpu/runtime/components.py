@@ -121,8 +121,6 @@ class Components:
         ``learner.data_parallel > 1`` the ring shards over a data mesh and
         the scan runs SPMD with the grad all-reduce inside
         (replay/device_dp.py — BASELINE config 4's fused spelling)."""
-        from ape_x_dqn_tpu.runtime.fused_learner import FusedDeviceLearner
-
         cfg = self.cfg
         mesh = None
         if cfg.learner.data_parallel > 1:
@@ -136,11 +134,7 @@ class Components:
         K = cfg.learner.steps_per_call
         freq = cfg.learner.q_target_sync_freq
         freq = max(K, freq - freq % K)
-        return FusedDeviceLearner(
-            self.network,
-            self.optimizer,
-            self.state,
-            self.obs_shape,
+        kwargs = dict(
             capacity=cfg.replay.capacity,
             batch_size=cfg.learner.replay_sample_size,
             steps_per_call=K,
@@ -150,6 +144,19 @@ class Components:
             loss_kind=cfg.learner.loss,
             sample_ahead=cfg.learner.sample_ahead,
             mesh=mesh,
+        )
+        if cfg.replay.dedup:
+            from ape_x_dqn_tpu.runtime.fused_dedup import FusedDedupLearner
+
+            return FusedDedupLearner(
+                self.network, self.optimizer, self.state, self.obs_shape,
+                frame_ratio=cfg.replay.frame_ratio, **kwargs,
+            )
+        from ape_x_dqn_tpu.runtime.fused_learner import FusedDeviceLearner
+
+        return FusedDeviceLearner(
+            self.network, self.optimizer, self.state, self.obs_shape,
+            **kwargs,
         )
 
     def make_fleet(self, seed_offset: int = 0) -> ActorFleet:
@@ -168,7 +175,18 @@ class Components:
             sync_every=cfg.actor.sync_every,
             seed=cfg.seed + seed_offset,
             emission=cfg.actor.emission,
+            emit_dedup=cfg.replay.dedup,
+            emit_dedup_groups=dedup_groups(cfg),
         )
+
+
+def dedup_groups(cfg: ApexConfig) -> int:
+    """Independent dedup streams per fleet: the sharded dedup ring routes
+    whole sources to shards, so every fleet must present one source per
+    shard or ingest would starve (replay/device_dedup_dp.py docstring)."""
+    if cfg.replay.dedup and cfg.learner.device_replay:
+        return max(1, cfg.learner.data_parallel)
+    return 1
 
 
 def build_components(cfg: ApexConfig) -> Components:
@@ -219,6 +237,14 @@ def build_components(cfg: ApexConfig) -> Components:
         # Throughput mode keeps the ring in HBM (make_fused_learner); the
         # host replay would be ~capacity × 2 frames of dead host RAM.
         replay = None
+    elif cfg.replay.dedup:
+        from ape_x_dqn_tpu.replay import DedupReplay
+
+        replay = DedupReplay(
+            cfg.replay.capacity, obs_shape,
+            priority_exponent=cfg.replay.priority_exponent,
+            frame_ratio=cfg.replay.frame_ratio,
+        )
     else:
         replay = PrioritizedReplay(
             cfg.replay.capacity, obs_shape,

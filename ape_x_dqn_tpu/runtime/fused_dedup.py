@@ -1,0 +1,552 @@
+"""Host driver for the frame-dedup device replay (HBM dedup ring + fused
+K-step scan) — the dedup twin of runtime/fused_learner.FusedDeviceLearner,
+same duck-typed interface (add_chunk / ingest_staged / train / state_dict /
+load_state_dict / size / staged_rows / params_for_publish), so the async
+pipeline and checkpoint layer drive either without knowing which.
+
+Staging here is two streams instead of one: actors ship DedupChunks
+(frames + refs); the stager resolves refs to ABSOLUTE per-shard frame
+sequence numbers (int64 host counters, reduced mod the device's int32-safe
+Q only at ship time), pins each source to a shard (carry refs must resolve
+on the device that holds the previous chunk's frames), and ships
+fixed-size FRAME blocks before the TRANSITION blocks that reference them
+(a transition block is eligible only when every frame it references has
+landed).  Thread discipline matches FusedDeviceLearner: actor threads only
+stage; all device work happens on the single train() caller.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ape_x_dqn_tpu.learner.train_step import build_train_step
+from ape_x_dqn_tpu.types import DedupChunk, TrainState
+
+_TXN_FIELDS = ("obs_seq", "next_seq", "action", "reward", "discount", "prio")
+
+
+class _ShardStage:
+    """One shard's staged streams (frames + ref-resolved transitions)."""
+
+    def __init__(self):
+        self.fbuf: list = []          # frame arrays, stage order
+        self.f_rows = 0               # staged frame rows not yet shipped
+        self.fseq = 0                 # next absolute frame seq to assign
+        self.shipped_f = 0            # frames already on the device
+        # Transition chunks: dict of arrays + max_ref (eligibility gate).
+        self.tbuf: list = []
+        self.t_rows = 0
+
+
+class DedupStager:
+    """Ref resolution + per-shard block scheduling (host side, pure numpy).
+
+    Mirrors the host DedupReplay's carry semantics exactly: per-source
+    (chunk_seq, base, U) continuity records; a gap drops only the carried
+    rows (``dropped_carry``)."""
+
+    def __init__(self, n_shards: int = 1):
+        from ape_x_dqn_tpu.replay.dedup import CarryResolver
+
+        self.n = int(n_shards)
+        self.shards = [_ShardStage() for _ in range(self.n)]
+        # Carry resolution is per SHARD (each shard is an independent frame
+        # seq space) — the same resolver the host DedupReplay uses.
+        self.resolvers = [CarryResolver() for _ in range(self.n)]
+        self.shard_of: dict = {}      # src -> pinned shard
+        self._rr = 0
+
+    @property
+    def dropped_carry(self) -> int:
+        return sum(r.dropped_carry for r in self.resolvers)
+
+    @property
+    def sources(self) -> dict:
+        """src -> (shard, chunk_seq, base, U) — the combined view."""
+        out = {}
+        for i, r in enumerate(self.resolvers):
+            for src, (seq, base, U) in r.sources.items():
+                if self.shard_of.get(src) == i:
+                    out[src] = (i, seq, base, U)
+        return out
+
+    def add_chunk(self, priorities: np.ndarray, chunk: DedupChunk) -> int:
+        """Stage one chunk; returns transition rows accepted."""
+        shard_i = self.shard_of.get(chunk.source)
+        fresh = shard_i is None
+        if fresh:
+            shard_i = self._rr % self.n
+            self._rr += 1
+            self.shard_of[chunk.source] = shard_i
+        st = self.shards[shard_i]
+        base = st.fseq
+        obs_seq, next_seq, keep = self.resolvers[shard_i].resolve(
+            chunk, base
+        )
+        if fresh and len(self.shard_of) > 2 * 4096 * self.n:
+            # Prune pins whose source record the resolvers have already
+            # evicted (dead fleets).  AFTER resolve(), so the source just
+            # pinned is in its resolver's live set and keeps its pin —
+            # pruning first would unpin it and drop its next chunk's
+            # carry rows despite a contiguous stream (round-5 review).
+            live = set()
+            for r in self.resolvers:
+                live |= set(r.sources)
+            self.shard_of = {
+                s: sh for s, sh in self.shard_of.items() if s in live
+            }
+        U = chunk.frames.shape[0]
+        st.fbuf.append(np.asarray(chunk.frames))
+        st.f_rows += U
+        st.fseq = base + U
+        m = int(keep.sum())
+        if m:
+            st.tbuf.append({
+                "obs_seq": obs_seq[keep],
+                "next_seq": next_seq[keep],
+                "action": np.asarray(chunk.action, np.int32)[keep],
+                "reward": np.asarray(chunk.reward, np.float32)[keep],
+                "discount": np.asarray(chunk.discount, np.float32)[keep],
+                "prio": np.asarray(priorities, np.float32)[keep],
+                # Eligibility gate: every ref < shipped frame count.
+                "max_ref": int(next_seq[keep].max()),
+            })
+            st.t_rows += m
+        return m
+
+    # ---- block extraction ------------------------------------------
+
+    def frame_blocks_available(self, block: int) -> int:
+        return min(s.f_rows // block for s in self.shards)
+
+    def take_frame_block(self, block: int) -> np.ndarray:
+        """[n, block, *obs] — one block per shard (call only when
+        frame_blocks_available >= 1)."""
+        out = []
+        for s in self.shards:
+            rows, need = [], block
+            while need:
+                head = s.fbuf[0]
+                if head.shape[0] <= need:
+                    rows.append(head)
+                    need -= head.shape[0]
+                    s.fbuf.pop(0)
+                else:
+                    rows.append(head[:need])
+                    s.fbuf[0] = head[need:]
+                    need = 0
+            s.f_rows -= block
+            s.shipped_f += block
+            out.append(np.concatenate(rows) if len(rows) > 1 else rows[0])
+        return np.stack(out)
+
+    def _eligible_rows(self, s: _ShardStage) -> int:
+        rows = 0
+        for c in s.tbuf:
+            if c["max_ref"] >= s.shipped_f:
+                break
+            rows += len(c["prio"])
+        return rows
+
+    def txn_blocks_available(self, block: int) -> int:
+        return min(self._eligible_rows(s) // block for s in self.shards)
+
+    def take_txn_block(self, block: int) -> dict:
+        """{field: [n, block] array} — one eligible block per shard."""
+        out = {f: [] for f in _TXN_FIELDS}
+        for s in self.shards:
+            need = block
+            acc = {f: [] for f in _TXN_FIELDS}
+            while need:
+                head = s.tbuf[0]
+                k = len(head["prio"])
+                if k <= need:
+                    for f in _TXN_FIELDS:
+                        acc[f].append(head[f])
+                    need -= k
+                    s.tbuf.pop(0)
+                else:
+                    for f in _TXN_FIELDS:
+                        acc[f].append(head[f][:need])
+                        head[f] = head[f][need:]
+                    need = 0
+            s.t_rows -= block
+            for f in _TXN_FIELDS:
+                out[f].append(
+                    np.concatenate(acc[f]) if len(acc[f]) > 1 else acc[f][0]
+                )
+        return {f: np.stack(v) for f, v in out.items()}
+
+    @property
+    def staged_rows(self) -> int:
+        return sum(s.t_rows for s in self.shards)
+
+    # ---- snapshot ----------------------------------------------------
+
+    def state_dict(self) -> dict:
+        out = {"n_shards": self.n}
+        for i, s in enumerate(self.shards):
+            out[f"s{i}_frames"] = (
+                np.concatenate(s.fbuf) if s.fbuf
+                else np.zeros((0,), np.uint8)
+            )
+            out[f"s{i}_fseq"] = s.fseq
+            out[f"s{i}_shipped_f"] = s.shipped_f
+            for f in _TXN_FIELDS:
+                out[f"s{i}_{f}"] = (
+                    np.concatenate([c[f] for c in s.tbuf]) if s.tbuf
+                    else np.zeros((0,))
+                )
+            out[f"s{i}_maxref"] = np.array(
+                [c["max_ref"] for c in s.tbuf], np.int64
+            )
+            out[f"s{i}_rows"] = np.array(
+                [len(c["prio"]) for c in s.tbuf], np.int64
+            )
+            out[f"s{i}_dropped"] = self.resolvers[i].dropped_carry
+            ids, rows = self.resolvers[i].state_arrays()
+            out[f"s{i}_src_ids"] = ids
+            out[f"s{i}_src_state"] = rows
+        src = self.shard_of
+        out["shard_of_ids"] = np.array(list(src.keys()), np.int64)
+        out["shard_of_vals"] = np.array(list(src.values()), np.int64)
+        out["rr"] = self._rr
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        if int(state["n_shards"]) != self.n:
+            raise ValueError(
+                f"stager snapshot has {int(state['n_shards'])} shards, "
+                f"configured {self.n}"
+            )
+        for i, s in enumerate(self.shards):
+            fr = state[f"s{i}_frames"]
+            s.fbuf = [fr] if fr.shape[0] else []
+            s.f_rows = int(fr.shape[0])
+            s.fseq = int(state[f"s{i}_fseq"])
+            s.shipped_f = int(state[f"s{i}_shipped_f"])
+            s.tbuf, s.t_rows = [], 0
+            rows = state[f"s{i}_rows"]
+            maxref = state[f"s{i}_maxref"]
+            off = 0
+            for j, k in enumerate(rows):
+                k = int(k)
+                c = {
+                    f: state[f"s{i}_{f}"][off:off + k]
+                    for f in _TXN_FIELDS
+                }
+                c["max_ref"] = int(maxref[j])
+                s.tbuf.append(c)
+                s.t_rows += k
+                off += k
+            self.resolvers[i].dropped_carry = int(state[f"s{i}_dropped"])
+            self.resolvers[i].load_state_arrays(
+                state[f"s{i}_src_ids"], state[f"s{i}_src_state"]
+            )
+        self.shard_of = {
+            int(a): int(v)
+            for a, v in zip(state["shard_of_ids"], state["shard_of_vals"])
+        }
+        self._rr = int(state["rr"])
+
+
+class FusedDedupLearner:
+    """Owns the dedup device replay + train state; drives fused K-step
+    calls.  Interface-compatible with FusedDeviceLearner (the runtime and
+    checkpoint layers are agnostic); ``mesh`` switches to the sharded ring
+    (replay/device_dedup_dp.py) with sources pinned per shard."""
+
+    def __init__(
+        self,
+        network,
+        optimizer,
+        state: TrainState,
+        obs_shape,
+        capacity: int,
+        batch_size: int = 32,
+        steps_per_call: int = 128,
+        ingest_block: int = 256,
+        priority_exponent: float = 0.6,
+        target_sync_freq: int = 2500,
+        loss_kind: str = "huber",
+        sample_ahead: bool = False,
+        frame_ratio: float = 1.25,
+        mesh=None,
+    ):
+        from ape_x_dqn_tpu.replay.device_dedup import (
+            build_dedup_fused_learn_step,
+            dedup_device_add_frames,
+            dedup_device_add_transitions,
+            init_dedup_device_replay,
+        )
+
+        self._capacity = int(capacity)
+        self._batch_size = int(batch_size)
+        self.steps_per_call = int(steps_per_call)
+        self._ingest_block = int(ingest_block)
+        self._mesh = mesh
+        self._prio_exp = priority_exponent
+        step_kwargs = dict(
+            loss_kind=loss_kind, sync_in_step=False, jit=False
+        )
+        if mesh is None:
+            self._n_shards = 1
+            self._state = state
+            self._replay = init_dedup_device_replay(
+                capacity, obs_shape, frame_ratio=frame_ratio
+            )
+            self._seq_mod = self._replay.seq_modulus
+            step_fn = build_train_step(network, optimizer, **step_kwargs)
+            self._fused = build_dedup_fused_learn_step(
+                step_fn, batch_size, steps_per_call=self.steps_per_call,
+                priority_exponent=priority_exponent,
+                target_sync_freq=target_sync_freq,
+                sample_ahead=sample_ahead,
+            )
+            _af = jax.jit(dedup_device_add_frames, donate_argnums=(0,))
+            _at = jax.jit(
+                lambda st, o, nx, a, r, d, p: dedup_device_add_transitions(
+                    st, o, nx, a, r, d, p, priority_exponent
+                ),
+                donate_argnums=(0,),
+            )
+            self._add_frames = lambda st, fr: _af(st, jnp.asarray(fr[0]))
+            self._add_txns = lambda st, blk: _at(
+                st,
+                jnp.asarray(blk["obs_seq"][0] % self._seq_mod, jnp.int32),
+                jnp.asarray(blk["next_seq"][0] % self._seq_mod, jnp.int32),
+                jnp.asarray(blk["action"][0]),
+                jnp.asarray(blk["reward"][0]),
+                jnp.asarray(blk["discount"][0]),
+                jnp.asarray(blk["prio"][0]),
+            )
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ape_x_dqn_tpu.replay.device_dedup_dp import (
+                build_sharded_dedup_add_frames,
+                build_sharded_dedup_add_transitions,
+                build_sharded_dedup_fused_learn_step,
+                init_sharded_dedup_replay,
+                shard_seq_modulus,
+            )
+
+            n = mesh.shape["data"]
+            self._n_shards = n
+            # Identity-jit, not device_put: the fused call donates this
+            # state and an aliased placement would free the caller's copy.
+            self._state = jax.jit(
+                lambda s: s, out_shardings=NamedSharding(mesh, P())
+            )(state)
+            self._replay = init_sharded_dedup_replay(
+                capacity, obs_shape, mesh, frame_ratio=frame_ratio
+            )
+            self._seq_mod = shard_seq_modulus(
+                self._replay.frame_capacity, n
+            )
+            step_fn = build_train_step(
+                network, optimizer, grad_reduce_axis="data", **step_kwargs
+            )
+            self._fused = build_sharded_dedup_fused_learn_step(
+                step_fn, mesh, batch_size,
+                steps_per_call=self.steps_per_call,
+                priority_exponent=priority_exponent,
+                target_sync_freq=target_sync_freq,
+                sample_ahead=sample_ahead,
+            )
+            _af = build_sharded_dedup_add_frames(mesh)
+            _at = build_sharded_dedup_add_transitions(
+                mesh, priority_exponent
+            )
+            row = NamedSharding(mesh, P("data"))
+            place = lambda a: jax.device_put(np.asarray(a), row)  # noqa: E731
+            self._add_frames = lambda st, fr: _af(st, place(fr))
+            self._add_txns = lambda st, blk: _at(
+                st,
+                place((blk["obs_seq"] % self._seq_mod).astype(np.int32)),
+                place((blk["next_seq"] % self._seq_mod).astype(np.int32)),
+                place(blk["action"]),
+                place(blk["reward"]),
+                place(blk["discount"]),
+                place(blk["prio"]),
+            )
+        self._rng = jax.random.fold_in(state.rng, 0x5EED)
+        self._stager = DedupStager(self._n_shards)
+        # learner.ingest_block is the TOTAL rows per ingest dispatch
+        # (FusedDeviceLearner contract); the stager takes per-shard blocks.
+        if self._ingest_block % self._n_shards:
+            raise ValueError(
+                f"ingest_block {self._ingest_block} must divide by the "
+                f"data-axis extent {self._n_shards}"
+            )
+        self._ingest_block //= self._n_shards
+        self._lock = threading.Lock()
+        self._size = 0
+
+    # ------------------------------------------------------------- sinks
+
+    def add_chunk(self, priorities: np.ndarray, transitions: DedupChunk):
+        if not isinstance(transitions, DedupChunk):
+            raise TypeError(
+                "FusedDedupLearner consumes DedupChunks — build fleets with "
+                "emit_dedup=True (config replay.dedup wires both ends)"
+            )
+        with self._lock:
+            self._stager.add_chunk(
+                np.asarray(priorities, np.float32), transitions
+            )
+
+    @property
+    def size(self) -> int:
+        return min(self._size, self._capacity)
+
+    @property
+    def staged_rows(self) -> int:
+        with self._lock:
+            return self._stager.staged_rows
+
+    @property
+    def state(self) -> TrainState:
+        return self._state
+
+    @state.setter
+    def state(self, new_state: TrainState):
+        self._state = new_state
+
+    @property
+    def step(self) -> int:
+        return int(np.asarray(self._state.step))
+
+    def params_for_publish(self):
+        return self._state.params
+
+    # ------------------------------------------------------------- learner
+
+    def ingest_staged(self, drain: bool = False) -> int:
+        """Ship staged frame blocks, then eligible transition blocks, in
+        fixed ``ingest_block`` units (frames first — a transition block
+        only ships once every frame it references is on the device).
+        ``drain=True`` additionally ships power-of-2 sub-blocks of the
+        tails, frames before transitions, so checkpoint-cadence drains
+        leave (at most) transitions whose frames are still host-side —
+        those stay staged and ride the snapshot."""
+        m = self._ingest_block
+        ingested = 0
+        with self._lock:
+            while self._stager.frame_blocks_available(m) >= 1:
+                self._replay = self._add_frames(
+                    self._replay, self._stager.take_frame_block(m)
+                )
+            if drain:
+                self._drain_stream_locked(
+                    lambda b: self._stager.frame_blocks_available(b),
+                    lambda b: self._replay_add_frames_block(b),
+                )
+            while self._stager.txn_blocks_available(m) >= 1:
+                self._replay = self._add_txns(
+                    self._replay, self._stager.take_txn_block(m)
+                )
+                ingested += m * self._n_shards
+            if drain:
+                ingested += self._drain_stream_locked(
+                    lambda b: self._stager.txn_blocks_available(b),
+                    lambda b: self._replay_add_txns_block(b),
+                )
+        self._size += ingested
+        return ingested
+
+    def _replay_add_frames_block(self, block: int) -> int:
+        self._replay = self._add_frames(
+            self._replay, self._stager.take_frame_block(block)
+        )
+        return 0
+
+    def _replay_add_txns_block(self, block: int) -> int:
+        self._replay = self._add_txns(
+            self._replay, self._stager.take_txn_block(block)
+        )
+        return block * self._n_shards
+
+    def _drain_stream_locked(self, available, ship) -> int:
+        """Ship the stream's tail in maximal power-of-2 sub-blocks (static
+        shapes: at most log2(ingest_block) jit variants, cached)."""
+        total = 0
+        b = self._ingest_block >> 1
+        while b >= 1:
+            while available(b) >= 1:
+                total += ship(b)
+            b >>= 1
+        return total
+
+    # -- snapshot (checkpointing) ----------------------------------------
+
+    def state_dict(self) -> dict:
+        r = jax.device_get(self._replay)
+        out = {
+            "dedup": np.asarray(True),
+            "frames": r.frames, "obs_ref": r.obs_ref,
+            "next_ref": r.next_ref, "action": r.action,
+            "reward": r.reward, "discount": r.discount, "mass": r.mass,
+            "cursor": np.asarray(r.cursor), "count": np.asarray(r.count),
+            "fcount": np.asarray(r.fcount),
+        }
+        with self._lock:
+            stage = self._stager.state_dict()
+        for k, v in stage.items():
+            out[f"stage_{k}"] = v
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        if "dedup" not in state:
+            raise ValueError(
+                "snapshot is not a dedup-ring snapshot — replay layouts "
+                "(replay.dedup) must match across save/restore"
+            )
+        want = tuple(self._replay.frames.shape)
+        got = tuple(state["frames"].shape)
+        if want != got:
+            raise ValueError(
+                f"replay snapshot frame ring {got} != configured {want}"
+            )
+        if tuple(np.shape(state["cursor"])) != tuple(self._replay.cursor.shape):
+            raise ValueError(
+                "snapshot shard layout != configured data_parallel extent"
+            )
+        from ape_x_dqn_tpu.replay.device_dedup import DedupDeviceReplayState
+
+        if self._mesh is not None:
+            place = lambda key, live: jax.device_put(  # noqa: E731
+                np.asarray(state[key]), live.sharding
+            )
+        else:
+            place = lambda key, live: jnp.asarray(state[key])  # noqa: E731
+        self._replay = DedupDeviceReplayState(
+            frames=place("frames", self._replay.frames),
+            obs_ref=place("obs_ref", self._replay.obs_ref),
+            next_ref=place("next_ref", self._replay.next_ref),
+            action=place("action", self._replay.action),
+            reward=place("reward", self._replay.reward),
+            discount=place("discount", self._replay.discount),
+            mass=place("mass", self._replay.mass),
+            cursor=place("cursor", self._replay.cursor),
+            count=place("count", self._replay.count),
+            fcount=place("fcount", self._replay.fcount),
+        )
+        self._size = int(np.sum(state["count"]))
+        with self._lock:
+            self._stager.load_state_dict({
+                k[len("stage_"):]: v for k, v in state.items()
+                if k.startswith("stage_")
+            })
+
+    def train(self, beta: float):
+        self._rng, sub = jax.random.split(self._rng)
+        self._state, self._replay, metrics = self._fused(
+            self._state, self._replay, beta, sub
+        )
+        return metrics

@@ -286,6 +286,9 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
     try:
         from ape_x_dqn_tpu.actors import ActorFleet
         from ape_x_dqn_tpu.envs import make_env
+        from ape_x_dqn_tpu.runtime.components import (
+            dedup_groups as _dedup_groups,
+        )
 
         cfg = _cfg_from_dict(cfg_dict)
         N = cfg.actor.num_actors
@@ -315,6 +318,8 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
             epsilon_index_offset=lo,
             epsilon_total=N,
             emission=cfg.actor.emission,
+            emit_dedup=cfg.replay.dedup,
+            emit_dedup_groups=_dedup_groups(cfg),
         )
         buf = SharedParamBuffer(shm_capacity, name=shm_name, create=False)
         source = SharedBufferParamSource(buf, template)
@@ -334,12 +339,18 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                 param_source=source,
             )
             for c in chunks:
+                if cfg.replay.dedup:
+                    # DedupChunk is a NamedTuple of arrays + int identity
+                    # fields — ships as a plain dict (types.DedupChunk).
+                    payload = ("dxp", c.transitions._asdict())
+                else:
+                    payload = ("xp", {
+                        f: np.asarray(getattr(c.transitions, f))
+                        for f in ("obs", "action", "reward", "discount",
+                                  "next_obs")})
                 xp_queue.put((
-                    "xp", worker_id, fleet.param_version,
-                    np.asarray(c.priorities),
-                    {f: np.asarray(getattr(c.transitions, f))
-                     for f in ("obs", "action", "reward", "discount", "next_obs")},
-                    c.actor_steps,
+                    payload[0], worker_id, fleet.param_version,
+                    np.asarray(c.priorities), payload[1], c.actor_steps,
                 ))
             if stats:
                 xp_queue.put((
@@ -493,7 +504,7 @@ class ProcessActorPool:
             except queue_mod.Empty:
                 break
             kind = msg[0]
-            if kind == "xp":
+            if kind in ("xp", "dxp"):
                 _, wid, version, prio, tdict, steps = msg
                 self.last_versions[wid] = version
                 self.actor_steps += steps
@@ -503,7 +514,12 @@ class ProcessActorPool:
                 self._steps_by_worker[wid] = (
                     self._steps_by_worker.get(wid, 0) + steps // max(n_w, 1)
                 )
-                out.append((prio, self._NStepTransition(**tdict)))
+                if kind == "dxp":
+                    from ape_x_dqn_tpu.types import DedupChunk
+
+                    out.append((prio, DedupChunk(**tdict)))
+                else:
+                    out.append((prio, self._NStepTransition(**tdict)))
             elif kind == "episodes":
                 self.episodes.extend(msg[2])
             elif kind == "done":

@@ -113,6 +113,69 @@ class TestEmissionEquivalence:
         # The dedup win: ~1 frame per transition vs the double-store's 2.
         assert fr / tx < 1.15, (fr, tx)
 
+    def test_grouped_emission_decodes_to_dense(self):
+        """emit_dedup_groups=2: two independent sources per flush whose
+        concatenation (in actor-column order) equals the dense chunk."""
+        import jax
+
+        from ape_x_dqn_tpu.actors import ActorFleet, LocalParamSource
+        from ape_x_dqn_tpu.envs import make_env
+        from ape_x_dqn_tpu.models.dueling import DuelingMLP
+
+        net = DuelingMLP(num_actions=2, hidden_sizes=(8,))
+        params = net.init(
+            jax.random.PRNGKey(0), np.zeros((1, 5), np.uint8)
+        )
+        out = []
+        for dedup, groups in ((False, 1), (True, 2)):
+            fleet = ActorFleet(
+                [lambda: make_env("chain:5")] * 5, net, n_step=3,
+                flush_every=5, seed=7, emit_dedup=dedup,
+                emit_dedup_groups=groups,
+            )
+            fleet.sync_params(LocalParamSource(params))
+            chunks, _ = fleet.collect(40)
+            out.append(chunks)
+        dense, dd = out
+        assert len(dd) == 2 * len(dense)
+        prev = {}
+        # Group bounds for 5 actors / 2 groups: [0, 2), [2, 5).
+        for i, a in enumerate(dense):
+            ga, gb = dd[2 * i].transitions, dd[2 * i + 1].transitions
+            assert ga.source != gb.source
+            mats = [
+                materialize_dedup(g, prev.get(g.source)) for g in (ga, gb)
+            ]
+            S = a.transitions.action.shape[0] // 5
+            dense_2d = {
+                f: getattr(a.transitions, f).reshape(
+                    S, 5, *getattr(a.transitions, f).shape[1:]
+                )
+                for f in ("obs", "action", "reward", "discount", "next_obs")
+            }
+            for f in dense_2d:
+                np.testing.assert_array_equal(
+                    dense_2d[f][:, :2].reshape(
+                        -1, *dense_2d[f].shape[2:]
+                    ),
+                    getattr(mats[0], f), err_msg=f"{f} group 0 chunk {i}",
+                )
+                np.testing.assert_array_equal(
+                    dense_2d[f][:, 2:].reshape(
+                        -1, *dense_2d[f].shape[2:]
+                    ),
+                    getattr(mats[1], f), err_msg=f"{f} group 1 chunk {i}",
+                )
+            prio_2d = a.priorities.reshape(S, 5)
+            np.testing.assert_array_equal(
+                prio_2d[:, :2].reshape(-1), dd[2 * i].priorities
+            )
+            np.testing.assert_array_equal(
+                prio_2d[:, 2:].reshape(-1), dd[2 * i + 1].priorities
+            )
+            prev[ga.source] = ga
+            prev[gb.source] = gb
+
     def test_dedup_requires_flush_at_least_n(self):
         from ape_x_dqn_tpu.actors import ActorFleet
         from ape_x_dqn_tpu.envs import ChainMDP

@@ -1,0 +1,280 @@
+"""FusedDedupLearner (runtime driver for the dedup HBM ring): stager
+semantics, ingest scheduling, end-to-end equivalence with the double-store
+fused runtime, sharded mode, and checkpoint/resume (verdict item 1a)."""
+
+import jax
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.actors import ActorFleet, LocalParamSource
+from ape_x_dqn_tpu.envs import CatchEnv
+from ape_x_dqn_tpu.learner.train_step import init_train_state, make_optimizer
+from ape_x_dqn_tpu.models.dueling import DuelingMLP
+from ape_x_dqn_tpu.runtime.fused_dedup import DedupStager, FusedDedupLearner
+from ape_x_dqn_tpu.types import DedupChunk
+
+OBS = (10, 5, 1)
+
+
+def build_parts(seed=0):
+    net = DuelingMLP(num_actions=3, hidden_sizes=(16,))
+    opt = make_optimizer("adam", learning_rate=1e-3)
+    state = init_train_state(
+        net, opt, jax.random.PRNGKey(seed), np.zeros((1, *OBS), np.uint8)
+    )
+    return net, opt, state
+
+
+def collect_chunks(n_steps=64, num=4, dedup=True, seed=3, flush=8):
+    net, _, state = build_parts()
+    fleet = ActorFleet(
+        [lambda: CatchEnv(seed=5)] * num, net, n_step=3, flush_every=flush,
+        seed=seed, emit_dedup=dedup,
+    )
+    fleet.sync_params(LocalParamSource(state.params))
+    chunks, _ = fleet.collect(n_steps)
+    return chunks
+
+
+class TestDedupStager:
+    def chunk(self, src, seq, n_tx=4, carry=0, prev_frames=0, fbase=0):
+        U = n_tx + 1
+        frames = np.full((U, *OBS), (fbase + np.arange(U))[:, None, None, None]
+                         % 251, np.uint8)
+        return DedupChunk(
+            frames=frames,
+            obs_ref=np.concatenate([
+                -np.arange(carry, 0, -1, dtype=np.int32),
+                np.arange(n_tx, dtype=np.int32)]),
+            next_ref=np.concatenate([
+                np.zeros(carry, np.int32),
+                np.arange(1, n_tx + 1, dtype=np.int32)]),
+            action=np.zeros(n_tx + carry, np.int32),
+            reward=np.zeros(n_tx + carry, np.float32),
+            discount=np.ones(n_tx + carry, np.float32),
+            source=src, chunk_seq=seq, prev_frames=prev_frames,
+        )
+
+    def test_sources_pin_to_shards_round_robin(self):
+        st = DedupStager(n_shards=2)
+        for src in (7, 8, 9):
+            st.add_chunk(np.ones(4), self.chunk(src, 0))
+        assert st.sources[7][0] == 0
+        assert st.sources[8][0] == 1
+        assert st.sources[9][0] == 0
+        # Continuation chunks stay on the pinned shard.
+        st.add_chunk(np.ones(6), self.chunk(7, 1, carry=2, prev_frames=5))
+        assert st.sources[7][0] == 0
+        assert st.dropped_carry == 0
+
+    def test_txn_blocks_gate_on_shipped_frames(self):
+        st = DedupStager(n_shards=1)
+        st.add_chunk(np.ones(4), self.chunk(1, 0))
+        # 5 frames staged, 4 txns staged; nothing shipped yet.
+        assert st.frame_blocks_available(4) == 1
+        assert st.txn_blocks_available(4) == 0, (
+            "transitions must not ship before their frames"
+        )
+        _ = st.take_frame_block(4)  # ships frames 0-3; txns need frame 4
+        assert st.txn_blocks_available(4) == 0
+        _ = st.take_frame_block(1)
+        assert st.txn_blocks_available(4) == 1
+        blk = st.take_txn_block(4)
+        assert blk["obs_seq"].shape == (1, 4)
+        np.testing.assert_array_equal(blk["obs_seq"][0], [0, 1, 2, 3])
+        np.testing.assert_array_equal(blk["next_seq"][0], [1, 2, 3, 4])
+
+    def test_carry_gap_drops_carried_rows(self):
+        st = DedupStager(n_shards=1)
+        st.add_chunk(np.ones(4), self.chunk(1, 0))
+        st.add_chunk(np.ones(6), self.chunk(1, 3, carry=2, prev_frames=5))
+        assert st.dropped_carry == 2
+        assert st.staged_rows == 8  # 4 + (6-2)
+
+    def test_snapshot_roundtrip(self):
+        st = DedupStager(n_shards=2)
+        st.add_chunk(np.ones(4), self.chunk(1, 0))
+        st.add_chunk(np.ones(4), self.chunk(2, 0))
+        st.add_chunk(np.ones(6), self.chunk(1, 1, carry=2, prev_frames=5))
+        _ = st.take_frame_block(2)
+        snap = st.state_dict()
+        st2 = DedupStager(n_shards=2)
+        st2.load_state_dict(snap)
+        assert st2.staged_rows == st.staged_rows
+        assert st2.sources == st.sources
+        assert [s.shipped_f for s in st2.shards] == [
+            s.shipped_f for s in st.shards
+        ]
+        # The restored stager keeps shipping where the old one stopped.
+        assert st2.frame_blocks_available(1) == st.frame_blocks_available(1)
+
+
+class TestFusedDedupLearner:
+    def make_learner(self, state=None, mesh=None, **kw):
+        net, opt, st = build_parts()
+        defaults = dict(
+            capacity=2048, batch_size=8, steps_per_call=4, ingest_block=32,
+            target_sync_freq=8, sample_ahead=False, frame_ratio=1.5,
+        )
+        defaults.update(kw)
+        return FusedDedupLearner(
+            net, opt, state if state is not None else st, OBS,
+            mesh=mesh, **defaults,
+        )
+
+    def test_end_to_end_training(self):
+        learner = self.make_learner()
+        for c in collect_chunks(96):
+            learner.add_chunk(c.priorities, c.transitions)
+        n = learner.ingest_staged()
+        assert n > 0 and learner.size == n
+        for _ in range(3):
+            metrics = learner.train(0.4)
+        assert np.isfinite(np.asarray(metrics.loss)).all()
+        assert learner.step == 12
+
+    def test_rejects_dense_chunks(self):
+        learner = self.make_learner()
+        dense = collect_chunks(24, dedup=False)
+        with pytest.raises(TypeError, match="DedupChunk"):
+            learner.add_chunk(dense[0].priorities, dense[0].transitions)
+
+    def test_matches_double_store_runtime(self):
+        """Same actor stream into FusedDedupLearner and FusedDeviceLearner
+        (dense twin), same rng → identical params and losses."""
+        from ape_x_dqn_tpu.runtime.fused_learner import FusedDeviceLearner
+        from ape_x_dqn_tpu.types import materialize_dedup
+
+        net, opt, st_a = build_parts()
+        _, _, st_b = build_parts()
+        common = dict(
+            capacity=2048, batch_size=8, steps_per_call=4, ingest_block=32,
+            target_sync_freq=8,
+        )
+        a = FusedDedupLearner(net, opt, st_a, OBS, frame_ratio=2.0, **common)
+        b = FusedDeviceLearner(net, opt, st_b, OBS, **common)
+        chunks = collect_chunks(96)
+        prev = None
+        for c in chunks:
+            a.add_chunk(c.priorities, c.transitions)
+            b.add_chunk(c.priorities, materialize_dedup(c.transitions, prev))
+            prev = c.transitions
+        # drain=True on both: in steady (non-drain) mode the dedup stager
+        # legitimately holds back transitions whose frame tail hasn't
+        # shipped yet; a full drain makes the ring contents identical.
+        na, nb = a.ingest_staged(drain=True), b.ingest_staged(drain=True)
+        assert na == nb > 0
+        for i in range(3):
+            ma = a.train(0.4)
+            mb = b.train(0.4)
+            np.testing.assert_allclose(
+                np.asarray(ma.loss), np.asarray(mb.loss), rtol=1e-6,
+                err_msg=f"call {i}",
+            )
+        jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-6
+            ),
+            a.state.params, b.state.params,
+        )
+
+    def test_checkpoint_roundtrip_with_staged_rows(self):
+        learner = self.make_learner()
+        chunks = collect_chunks(96)
+        for c in chunks[:-2]:
+            learner.add_chunk(c.priorities, c.transitions)
+        learner.ingest_staged()
+        for _ in range(2):
+            learner.train(0.4)
+        # Stage more rows that DON'T align to a block: they must survive
+        # the snapshot (no padding, no loss).
+        for c in chunks[-2:]:
+            learner.add_chunk(c.priorities, c.transitions)
+        staged_before = learner.staged_rows
+        snap = learner.state_dict()
+
+        net, opt, st2 = build_parts(seed=9)
+        restored = FusedDedupLearner(
+            net, opt, st2, OBS, capacity=2048, batch_size=8,
+            steps_per_call=4, ingest_block=32, target_sync_freq=8,
+            frame_ratio=1.5,
+        )
+        restored.load_state_dict(snap)
+        assert restored.staged_rows == staged_before
+        assert restored.size == learner.size
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(restored._replay.mass)),
+            np.asarray(snap["mass"]),
+        )
+        # The restored learner keeps training and ingesting.
+        restored.ingest_staged(drain=True)
+        m = restored.train(0.4)
+        assert np.isfinite(np.asarray(m.loss)).all()
+
+    def test_drain_ships_unaligned_tails(self):
+        learner = self.make_learner(ingest_block=64)
+        chunks = collect_chunks(40)  # 40 steps x 4 actors ≈ 132 rows
+        for c in chunks:
+            learner.add_chunk(c.priorities, c.transitions)
+        n_full = learner.ingest_staged()
+        n_drain = learner.ingest_staged(drain=True)
+        assert n_drain > 0
+        # After a drain, only frame-ineligible transitions may remain;
+        # with all frames drained first, that's at most... 0.
+        assert learner.staged_rows == 0, (
+            "drain must ship every staged transition once its frames land"
+        )
+        assert learner.size == n_full + n_drain
+
+
+class TestShardedFusedDedup:
+    def test_sharded_mode_trains_and_checkpoints(self):
+        from ape_x_dqn_tpu.parallel import make_mesh
+
+        mesh = make_mesh(num_devices=4)
+        net, opt, st = build_parts()
+        learner = FusedDedupLearner(
+            net, opt, st, OBS, capacity=4096, batch_size=8,
+            steps_per_call=4, ingest_block=64, target_sync_freq=8,
+            frame_ratio=1.5, mesh=mesh,
+        )
+        # 8 sources (fleet incarnations) spread over 4 shards.
+        for s in range(8):
+            for c in collect_chunks(48, num=2, seed=100 + s):
+                learner.add_chunk(c.priorities, c.transitions)
+        n = learner.ingest_staged()
+        assert n > 0 and n % 4 == 0
+        for _ in range(3):
+            metrics = learner.train(0.4)
+        assert np.isfinite(np.asarray(metrics.loss)).all()
+        assert learner.step == 12
+        snap = learner.state_dict()
+        _, _, st2 = build_parts(seed=1)
+        r2 = FusedDedupLearner(
+            net, opt, st2, OBS, capacity=4096, batch_size=8,
+            steps_per_call=4, ingest_block=64, target_sync_freq=8,
+            frame_ratio=1.5, mesh=make_mesh(num_devices=4),
+        )
+        r2.load_state_dict(snap)
+        assert r2.size == learner.size
+        m = r2.train(0.4)
+        assert np.isfinite(np.asarray(m.loss)).all()
+
+    def test_shard_layout_mismatch_rejected(self):
+        from ape_x_dqn_tpu.parallel import make_mesh
+
+        net, opt, st = build_parts()
+        learner = FusedDedupLearner(
+            net, opt, st, OBS, capacity=4096, batch_size=8,
+            steps_per_call=4, ingest_block=64, target_sync_freq=8,
+            mesh=make_mesh(num_devices=4),
+        )
+        snap = learner.state_dict()
+        _, _, st2 = build_parts(seed=1)
+        two = FusedDedupLearner(
+            net, opt, st2, OBS, capacity=4096, batch_size=8,
+            steps_per_call=4, ingest_block=64, target_sync_freq=8,
+            mesh=make_mesh(num_devices=2),
+        )
+        with pytest.raises(ValueError):
+            two.load_state_dict(snap)

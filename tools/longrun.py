@@ -235,7 +235,15 @@ def main() -> int:
 
     steps_a = series(rec_a, "step")
     steps_b = series(rec_b, "step")
-    rate = series(rec_a + rec_b, "steps_per_sec")
+    # Derive emit-to-emit rates from the step/time series: the runtime's
+    # 30 s sliding-window field is bursty under drain-all forcing (a window
+    # between force points legitimately reads 0), which would make the
+    # flatness summary meaningless.
+    rate = []
+    for ser in (steps_a, steps_b):
+        for (t_prev, s_prev), (t_cur, s_cur) in zip(ser, ser[1:]):
+            if t_cur > t_prev and s_cur > s_prev:
+                rate.append((t_cur, (s_cur - s_prev) / (t_cur - t_prev)))
     evals = series(rec_a + rec_b, "eval/score")
     rss = [(r["t"], r["trainer_rss_mb"]) for r in sys_records
            if "trainer_rss_mb" in r and r["trainer_rss_mb"] > 0]
