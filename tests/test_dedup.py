@@ -357,3 +357,58 @@ class TestDedupEdges:
         ds_style = PrioritizedReplay(32, OBS, sum_tree_cls=SumTree)
         with pytest.raises(ValueError, match="dedup"):
             dd.load_state_dict(ds_style.state_dict())
+
+
+class TestDedupRuntimes:
+    """replay.dedup=true through BOTH host-replay runtimes (the fused
+    device runtimes are covered in test_fused_dedup): the deterministic
+    sync driver and the async pipeline's deferred priority write-back
+    against the liveness guard."""
+
+    def test_single_process_driver_trains_on_dedup(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+        from ape_x_dqn_tpu.replay import DedupReplay
+        from ape_x_dqn_tpu.runtime import SingleProcessDriver
+
+        cfg = ApexConfig()
+        cfg.env.name = "chain:5"
+        cfg.network = "mlp"
+        cfg.actor.num_actors = 4
+        cfg.actor.flush_every = 8
+        cfg.learner.min_replay_mem_size = 64
+        cfg.learner.optimizer = "adam"
+        cfg.replay.capacity = 2048
+        cfg.replay.dedup = True
+        driver = SingleProcessDriver(cfg)
+        assert isinstance(driver.replay, DedupReplay)
+        for _ in range(30):
+            res = driver.run_iteration()
+        assert driver.learner_step > 0
+        assert np.isfinite(res.loss)
+        assert driver.replay.stats["dropped_carry"] == 0
+
+    def test_async_pipeline_host_dedup_end_to_end(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+        from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
+        from ape_x_dqn_tpu.utils.metrics import MetricLogger
+        import io
+
+        cfg = ApexConfig()
+        cfg.env.name = "chain:5"
+        cfg.network = "mlp"
+        cfg.actor.num_actors = 4
+        cfg.actor.T = 100_000
+        cfg.actor.flush_every = 8
+        cfg.actor.sync_every = 16
+        cfg.learner.min_replay_mem_size = 64
+        cfg.learner.optimizer = "adam"
+        cfg.learner.publish_every = 10
+        cfg.replay.capacity = 2048
+        cfg.replay.dedup = True
+        pipe = AsyncPipeline(
+            cfg, logger=MetricLogger(stream=io.StringIO()), log_every=50
+        )
+        result = pipe.run(learner_steps=60, warmup_timeout=120.0)
+        assert result["step"] >= 60
+        assert np.isfinite(result["learner/loss"])
+        assert pipe.comps.replay.stats["dropped_carry"] == 0
