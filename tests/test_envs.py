@@ -286,3 +286,28 @@ class TestRealGymnasiumEndToEnd:
             )
         assert np.isfinite(np.asarray(metrics.loss))
         assert int(state.step) == 5
+
+
+class TestPixelUpscale:
+    def test_upscale_and_pad_geometry(self):
+        from ape_x_dqn_tpu.envs import CatchEnv, PixelUpscale
+
+        env = PixelUpscale(CatchEnv(seed=0), 84, 84)
+        obs = env.reset(seed=0)
+        assert obs.shape == (84, 84, 1) and obs.dtype == np.uint8
+        # 10x5 board -> 8x16 integer blocks + zero pad: exactly two
+        # lit rectangles (ball + paddle), each 8*16 pixels.
+        assert (obs > 0).sum() == 2 * 8 * 16
+        r = env.step(1)
+        assert r.obs.shape == (84, 84, 1)
+        assert env.num_actions == 3
+
+    def test_target_smaller_than_source_rejected(self):
+        from ape_x_dqn_tpu.envs import CatchEnv, PixelUpscale
+
+        with pytest.raises(ValueError):
+            PixelUpscale(CatchEnv(), 8, 8)
+
+    def test_factory_spec(self):
+        env = make_env("catch:32")
+        assert env.reset(seed=1).shape == (32, 32, 1)
