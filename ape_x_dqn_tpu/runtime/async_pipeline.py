@@ -42,6 +42,7 @@ from ape_x_dqn_tpu.config import ApexConfig
 from ape_x_dqn_tpu.runtime.components import build_components
 from ape_x_dqn_tpu.runtime.infeed import PrefetchQueue
 from ape_x_dqn_tpu.runtime.param_store import ParamStore
+from ape_x_dqn_tpu.utils.memory import trim_malloc
 from ape_x_dqn_tpu.utils.metrics import MetricLogger, RateCounter
 from ape_x_dqn_tpu.utils.profiling import StageTimer
 
@@ -209,6 +210,9 @@ class _ActorWorker:
                 with self._ep_lock:
                     self.episodes.extend(stats)
             self.heartbeat = time.monotonic()
+            # Arena hygiene (see utils/memory): the collect loop's obs
+            # allocation stream otherwise grows RSS without bound.
+            trim_malloc()
 
 
 class AsyncPipeline:
@@ -685,6 +689,10 @@ class AsyncPipeline:
     def _emit_fused(self, metrics, final: bool = False) -> dict:
         import numpy as np
 
+        # Arena hygiene at the log cadence: the learner thread's staging /
+        # snapshot / transfer scratch otherwise grows RSS ~MB/s for the
+        # life of the run (measured in the round-5 soak; utils/memory).
+        trim_malloc()
         eps = self.worker.drain_episodes()
         for e in eps:
             self.episode_returns.append(e.episode_return)
@@ -753,6 +761,7 @@ class AsyncPipeline:
         return np.asarray(priorities)
 
     def _emit(self, metrics=None, final: bool = False) -> dict:
+        trim_malloc()  # arena hygiene at the log cadence (utils/memory)
         eps = self.worker.drain_episodes()
         for e in eps:
             self.episode_returns.append(e.episode_return)

@@ -18,7 +18,8 @@ module is that mode, on the TPU-native transport stack:
   * **Experience transport** — one bounded ``mp.Queue`` carrying numpy
     chunk payloads (the analogue of the reference's unbounded manager
     queue, main.py:39, with backpressure by construction).
-  * **Worker processes** are CPU-only JAX (``JAX_PLATFORMS=cpu`` set before
+  * **Worker processes** are CPU-only JAX (pinned via ``jax.config`` — the
+    env var is not sufficient on plugin-pinning images — before
     the child imports jax): exactly one process — the learner — owns the
     TPU.  Each worker runs an ``ActorFleet`` over its slice of the global
     actor set, with the ε-ladder indexed globally (pool.py
@@ -282,6 +283,17 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
         f for f in flags.split()
         if "force_host_platform_device_count" not in f
     )
+    # The env var alone is NOT enough on images whose sitecustomize
+    # registers a TPU plugin at interpreter start and pins
+    # jax.config.jax_platforms to it (this container): without the
+    # explicit config override below, every "CPU-only" worker silently
+    # targeted the tunneled TPU — sharing (and contending for) the
+    # learner's device, and hanging outright when the tunnel degrades
+    # (round-5 finding; ROUND5_NOTES.md).  Pin via jax.config BEFORE any
+    # backend initializes — the one spelling that wins.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
     buf = None
     try:
         from ape_x_dqn_tpu.actors import ActorFleet
@@ -289,6 +301,7 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
         from ape_x_dqn_tpu.runtime.components import (
             dedup_groups as _dedup_groups,
         )
+        from ape_x_dqn_tpu.utils.memory import trim_malloc
 
         cfg = _cfg_from_dict(cfg_dict)
         N = cfg.actor.num_actors
@@ -358,6 +371,10 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                     [(s.actor_id + lo, s.episode_return, s.episode_length)
                      for s in stats],
                 ))
+            # Arena hygiene each quantum: the obs-batch allocation stream
+            # otherwise grows worker RSS ~0.65 MB/s forever (utils/memory
+            # docstring — measured in the round-5 flagship soak).
+            trim_malloc()
         xp_queue.put(("done", worker_id, fleet.step_count))
     except Exception as e:  # noqa: BLE001 — report, don't hang the join
         try:
