@@ -416,7 +416,16 @@ class ProcessActorPool:
         self.buffer = SharedParamBuffer(shm_capacity)
         self.store = SharedMemoryParamStore(self.buffer)
         self._ctx = mp.get_context("spawn")
-        self.queue = self._ctx.Queue(maxsize=queue_size)
+        # One experience queue PER WORKER INCARNATION (replaced on
+        # respawn): mp.Queue is not SIGKILL-safe — a worker killed mid-put
+        # leaves the queue's shared write lock held forever, deadlocking
+        # every other producer on that queue (its own respawn included).
+        # Round-5 finding: the elasticity tests hit this whenever the kill
+        # landed inside a put (probable with fast envs); per-incarnation
+        # queues confine the corruption to the dead incarnation, which is
+        # the only SIGKILL-safe discipline plain mp.Queue admits.
+        self._queue_size = int(queue_size)
+        self._queues: dict = {}
         self.stop_event = self._ctx.Event()
         self._cfg_dict = to_dict(cfg)
         self._quantum = quantum or cfg.actor.flush_every
@@ -433,6 +442,7 @@ class ProcessActorPool:
         self._reported_errors: dict = {}      # wid -> last error message
         self._attempt: dict = {}              # wid -> spawn attempt count
         self._dead_since: dict = {}           # wid -> first-seen-dead time
+        self._salvaged: list = []             # chunks drained pre-respawn
         self._silent_death_grace_s = 10.0
         # Per-host exploration component (multi-host SPMD: each host's
         # workers must not duplicate another host's streams).
@@ -441,16 +451,35 @@ class ProcessActorPool:
     def _spawn(self, wid: int, budget: int):
         attempt = self._attempt.get(wid, 0)
         self._attempt[wid] = attempt + 1
+        if wid in self._queues:
+            # Salvage whatever the dead incarnation fully enqueued, then
+            # abandon its queue (the write side may hold a dead process's
+            # lock — see __init__'s SIGKILL-safety note).
+            self._drain_queue(self._queues[wid])
+        self._queues[wid] = self._ctx.Queue(maxsize=self._queue_size)
         p = self._ctx.Process(
             target=_worker_main,
             args=(wid, self._cfg_dict, self.num_workers, self.buffer.name,
-                  self.buffer.capacity, self.queue, self.stop_event,
+                  self.buffer.capacity, self._queues[wid], self.stop_event,
                   budget, self._quantum, attempt, self._seed_base,
                   self.cfg.actor.worker_nice),
             daemon=True,
         )
         p.start()
         return p
+
+    def _drain_queue(self, q, limit: int = 4096) -> None:
+        import queue as queue_mod
+
+        for _ in range(limit):
+            try:
+                item = self._dispatch(q.get_nowait())
+            except queue_mod.Empty:
+                return
+            except Exception:  # torn pickle from a killed mid-put writer
+                return
+            if item is not None:
+                self._salvaged.append(item)
 
     def start(self):
         for w in range(self.num_workers):
@@ -506,55 +535,70 @@ class ProcessActorPool:
         return len(self.finished_workers) + len(self.worker_errors) >= self.num_workers
 
     def poll(self, max_items: int = 64, timeout: float = 0.0) -> List[tuple]:
-        """Drain up to ``max_items`` experience chunks; returns
-        [(priorities, NStepTransition), ...].  Episode stats / completion /
-        errors update pool state as a side effect."""
+        """Drain up to ``max_items`` experience chunks across every live
+        worker queue; returns [(priorities, transitions), ...].  Episode
+        stats / completion / errors update pool state as a side effect."""
         import queue as queue_mod
 
-        out = []
-        for i in range(max_items):
-            try:
-                if i == 0 and timeout:
-                    msg = self.queue.get(timeout=timeout)
-                else:
-                    msg = self.queue.get_nowait()
-            except queue_mod.Empty:
+        out = list(self._salvaged)
+        self._salvaged.clear()
+        deadline = time.monotonic() + timeout if timeout else None
+        while len(out) < max_items:
+            got = False
+            for q in list(self._queues.values()):
+                if len(out) >= max_items:
+                    break
+                try:
+                    item = self._dispatch(q.get_nowait())
+                except queue_mod.Empty:
+                    continue
+                got = True
+                if item is not None:
+                    out.append(item)
+            if not got:
+                if not out and deadline and time.monotonic() < deadline:
+                    time.sleep(min(0.01, timeout))
+                    continue
                 break
-            kind = msg[0]
-            if kind in ("xp", "dxp"):
-                _, wid, version, prio, tdict, steps = msg
-                self.last_versions[wid] = version
-                self.actor_steps += steps
-                # Fleet steps = chunk rows / actors-in-worker; tracked so a
-                # respawn only gets the worker's REMAINING actor.T budget.
-                n_w = self._worker_width(wid)
-                self._steps_by_worker[wid] = (
-                    self._steps_by_worker.get(wid, 0) + steps // max(n_w, 1)
-                )
-                if kind == "dxp":
-                    from ape_x_dqn_tpu.types import DedupChunk
-
-                    out.append((prio, DedupChunk(**tdict)))
-                else:
-                    out.append((prio, self._NStepTransition(**tdict)))
-            elif kind == "episodes":
-                self.episodes.extend(msg[2])
-            elif kind == "done":
-                self.finished_workers.add(msg[1])
-                # Cumulative fleet steps across incarnations (each "done"
-                # reports its own incarnation's count).  Restart-free runs
-                # land on actor.T exactly (the budget clamp in _worker_main);
-                # after a restart the respawn budget comes from chunk-based
-                # accounting, so the total is clamp-accurate only to the
-                # flush cadence.
-                self.final_steps[msg[1]] = (
-                    self.final_steps.get(msg[1], 0) + msg[2]
-                )
-            elif kind == "error":
-                # Recorded for supervise(): respawnable until the restart
-                # budget runs out, fatal after.
-                self._reported_errors[msg[1]] = msg[2]
         return out
+
+    def _dispatch(self, msg):
+        """Apply one worker message to pool state; returns an experience
+        tuple for 'xp'/'dxp' messages, else None."""
+        kind = msg[0]
+        if kind in ("xp", "dxp"):
+            _, wid, version, prio, tdict, steps = msg
+            self.last_versions[wid] = version
+            self.actor_steps += steps
+            # Fleet steps = chunk rows / actors-in-worker; tracked so a
+            # respawn only gets the worker's REMAINING actor.T budget.
+            n_w = self._worker_width(wid)
+            self._steps_by_worker[wid] = (
+                self._steps_by_worker.get(wid, 0) + steps // max(n_w, 1)
+            )
+            if kind == "dxp":
+                from ape_x_dqn_tpu.types import DedupChunk
+
+                return (prio, DedupChunk(**tdict))
+            return (prio, self._NStepTransition(**tdict))
+        if kind == "episodes":
+            self.episodes.extend(msg[2])
+        elif kind == "done":
+            self.finished_workers.add(msg[1])
+            # Cumulative fleet steps across incarnations (each "done"
+            # reports its own incarnation's count).  Restart-free runs
+            # land on actor.T exactly (the budget clamp in _worker_main);
+            # after a restart the respawn budget comes from chunk-based
+            # accounting, so the total is clamp-accurate only to the
+            # flush cadence.
+            self.final_steps[msg[1]] = (
+                self.final_steps.get(msg[1], 0) + msg[2]
+            )
+        elif kind == "error":
+            # Recorded for supervise(): respawnable until the restart
+            # budget runs out, fatal after.
+            self._reported_errors[msg[1]] = msg[2]
+        return None
 
     def _worker_width(self, wid: int) -> int:
         """Actors in worker ``wid``'s slice of the global set."""
