@@ -155,17 +155,38 @@ class ReplayConfig:
 
 
 @dataclasses.dataclass
+class ServingConfig:
+    """Policy-serving knobs (ape_x_dqn_tpu/serving/ + the serve CLI).
+
+    The training sections above have reference-parity provenance; this one
+    is new surface — the inference half the reference never had.
+    """
+
+    max_batch: int = 32          # largest bucket one jitted apply serves
+    max_wait_ms: float = 5.0     # deadline: oldest request's max queue wait
+    queue_capacity: int = 256    # admission-control bound (load-shed beyond)
+    reload_poll_s: float = 0.25  # param-source poll cadence (hot reload)
+
+
+@dataclasses.dataclass
 class ApexConfig:
     env: EnvConfig = dataclasses.field(default_factory=EnvConfig)
     actor: ActorConfig = dataclasses.field(default_factory=ActorConfig)
     learner: LearnerConfig = dataclasses.field(default_factory=LearnerConfig)
     replay: ReplayConfig = dataclasses.field(default_factory=ReplayConfig)
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     network: str = "conv"                 # "conv" | "nature" | "mlp"
     seed: int = 0
 
     def validate(self) -> "ApexConfig":
-        a, l, r = self.actor, self.learner, self.replay
+        a, l, r, s = self.actor, self.learner, self.replay, self.serving
         checks = [
+            (s.max_batch >= 1, "serving.max_batch must be >= 1"),
+            (s.max_wait_ms >= 0.0, "serving.max_wait_ms must be >= 0"),
+            (s.queue_capacity >= s.max_batch,
+             "serving.queue_capacity must be >= serving.max_batch (a full "
+             "batch must be admissible)"),
+            (s.reload_poll_s > 0.0, "serving.reload_poll_s must be > 0"),
             (a.num_actors >= 1, "actor.num_actors must be >= 1"),
             (a.num_steps >= 1, "actor.num_steps must be >= 1"),
             (0.0 <= a.epsilon <= 1.0, "actor.epsilon must be in [0, 1]"),
@@ -357,6 +378,7 @@ def _from_native_json(data: dict) -> ApexConfig:
     sections = {
         "env": EnvConfig, "actor": ActorConfig,
         "learner": LearnerConfig, "replay": ReplayConfig,
+        "serving": ServingConfig,
     }
     for key, value in data.items():
         if key in sections:

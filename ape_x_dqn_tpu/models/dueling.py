@@ -138,6 +138,24 @@ class DuelingMLP(nn.Module):
         return self(x)[2]
 
 
+def build_greedy_apply(network: nn.Module):
+    """Jitted serving entry: ``(params, obs[B]) -> (actions[B], q[B, A])``.
+
+    The inference twin of actors/pool.build_policy_step with the ε-greedy
+    draw removed: pure greedy ``argmax Q(s, .)`` per row, no RNG threading —
+    the compute kernel the serving batcher amortizes across clients
+    (serving/batcher.py).  Q comes back float32 so clients can audit the
+    argmax (tests pin padded-row independence through it).
+    """
+
+    @jax.jit
+    def greedy_apply(params, obs):
+        q = network.apply(params, obs)[2]
+        return jnp.argmax(q, axis=-1).astype(jnp.int32), q
+
+    return greedy_apply
+
+
 def build_network(kind: str, num_actions: int, **kwargs) -> nn.Module:
     """Factory keyed by config string: {"conv", "nature", "mlp"}."""
     if kind == "conv":

@@ -22,6 +22,7 @@ Here the channel is an atomic versioned snapshot in host RAM:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Optional, Tuple
 
 import jax
@@ -35,11 +36,22 @@ class ParamStore:
         self._params = jax.device_get(params) if params is not None else None
         # Initial params (if any) are version 0; each publish bumps by 1.
         self._version = 0
+        self._published_at = time.monotonic() if params is not None else None
 
     @property
     def version(self) -> int:
         with self._lock:
             return self._version
+
+    def age_s(self) -> Optional[float]:
+        """Seconds since the newest publish (None before the first) — the
+        staleness a reader holding the current version carries.  Readers
+        behind the current version add the publish gap on top; the serving
+        tier reports both (serving/server.py stats)."""
+        with self._lock:
+            if self._published_at is None:
+                return None
+            return time.monotonic() - self._published_at
 
     def publish(self, params: Any) -> int:
         """Snapshot device params to host and bump the version."""
@@ -47,6 +59,7 @@ class ParamStore:
         with self._lock:
             self._params = host
             self._version += 1
+            self._published_at = time.monotonic()
             return self._version
 
     def get(self, have_version: int = -1) -> Optional[Tuple[Any, int]]:

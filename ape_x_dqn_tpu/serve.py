@@ -1,0 +1,165 @@
+"""CLI serving mode: ``python -m ape_x_dqn_tpu.serve``.
+
+Two mounting modes for the same PolicyServer (serving/server.py):
+
+  * ``--checkpoint DIR`` — serve a trained Q-network from a checkpoint
+    root, hot-reloading whenever a newer committed ``step_N`` lands
+    (a training run writing checkpoints and a serving tier on the same
+    filesystem need nothing else to stay current);
+  * ``--attach`` — run the async trainer (runtime/async_pipeline.py) in
+    this process and serve from its LIVE ParamStore: one process both
+    trains and answers action requests, the learner's capped-rate publish
+    doubling as the serving reload feed.
+
+The server's client surface is in-process (``PolicyServer.act/submit`` —
+tools/loadgen.py is the reference client); this CLI drives it with a
+built-in closed-loop load (``--clients``) and emits the serving metrics
+as JSONL (serve/qps, serve/p99_ms, serve/param_version, ...), so a config
+can be sized — buckets, deadline, queue bound — before any transport
+(HTTP/gRPC) is bolted on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+from ape_x_dqn_tpu.config import load_config, to_dict
+from ape_x_dqn_tpu.utils.metrics import MetricLogger
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ape_x_dqn_tpu.serve",
+        description="Batched Q-network policy serving with hot param reload",
+    )
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument(
+        "--checkpoint", default=None, metavar="DIR",
+        help="serve from this checkpoint root (hot-reloads newer steps)",
+    )
+    src.add_argument(
+        "--attach", action="store_true",
+        help="run the async trainer in-process and serve its live params",
+    )
+    p.add_argument(
+        "--params-file", default=None,
+        help="JSON config (native or reference format) — must match the "
+        "checkpoint's network/env for --checkpoint",
+    )
+    p.add_argument(
+        "--set", dest="overrides", action="append", default=[],
+        metavar="PATH=VALUE",
+        help="config override, e.g. --set serving.max_batch=64",
+    )
+    p.add_argument(
+        "--duration", type=float, default=10.0,
+        help="seconds to serve (--attach stops earlier if training ends)",
+    )
+    p.add_argument(
+        "--clients", type=int, default=0,
+        help="built-in closed-loop demo clients (0 = idle serve)",
+    )
+    p.add_argument(
+        "--steps", type=int, default=None,
+        help="--attach: learner steps to train (default: config total)",
+    )
+    p.add_argument("--metrics-file", default=None, help="also write JSONL here")
+    p.add_argument("--metrics-every", type=float, default=2.0)
+    return p
+
+
+def _client_loop(server, obs_shape, stop, errors, seed):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    while not stop.is_set():
+        obs = rng.integers(0, 255, obs_shape, dtype=np.uint8)
+        try:
+            server.act(obs, timeout=30.0)
+        except Exception:  # noqa: BLE001 — counted, loop continues
+            errors.append(1)
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    cfg = load_config(args.params_file, overrides=args.overrides)
+    print("serving config:", to_dict(cfg), file=sys.stderr)
+    logger = MetricLogger(stream=sys.stdout, path=args.metrics_file)
+
+    from ape_x_dqn_tpu.runtime.components import build_components
+    from ape_x_dqn_tpu.serving import CheckpointParamSource, PolicyServer
+
+    pipe = None
+    trainer_thread = None
+    if args.attach:
+        # One process, both halves: the trainer owns the device hot loop,
+        # the serving batcher rides the same device between learner
+        # dispatches, params flow learner -> store -> server in host RAM.
+        from ape_x_dqn_tpu.runtime import AsyncPipeline
+
+        pipe = AsyncPipeline(cfg, logger=logger, log_every=10_000)
+        comps = pipe.comps
+        source = pipe.store
+        trainer_thread = threading.Thread(
+            target=lambda: pipe.run(learner_steps=args.steps),
+            name="attached-trainer", daemon=True,
+        )
+    else:
+        comps = build_components(cfg)
+        source = CheckpointParamSource(args.checkpoint, comps.state)
+        if source.version < 0:
+            print(f"no checkpoint under {args.checkpoint}", file=sys.stderr)
+            return 2
+
+    s = cfg.serving
+    server = PolicyServer(
+        comps.network,
+        param_source=source,
+        max_batch=s.max_batch,
+        max_wait_ms=s.max_wait_ms,
+        queue_capacity=s.queue_capacity,
+        reload_poll_s=s.reload_poll_s,
+    )
+    server.warmup(comps.obs_shape)
+    server.start()
+    if trainer_thread is not None:
+        trainer_thread.start()
+
+    stop = threading.Event()
+    errors: list = []
+    clients = [
+        threading.Thread(
+            target=_client_loop,
+            args=(server, comps.obs_shape, stop, errors, cfg.seed + i),
+            name=f"serve-client-{i}", daemon=True,
+        )
+        for i in range(args.clients)
+    ]
+    for c in clients:
+        c.start()
+    try:
+        deadline = time.monotonic() + args.duration
+        while time.monotonic() < deadline:
+            time.sleep(min(args.metrics_every, max(0.0, deadline - time.monotonic())))
+            server.emit_metrics(logger)
+            if trainer_thread is not None and not trainer_thread.is_alive():
+                break
+    finally:
+        stop.set()
+        for c in clients:
+            c.join(timeout=5.0)
+        if pipe is not None:
+            pipe.stop_event.set()
+        if trainer_thread is not None and trainer_thread.is_alive():
+            trainer_thread.join(timeout=30.0)
+        server.emit_metrics(logger, final=True)
+        server.close()
+        logger.close()
+    return 0 if not errors else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,42 @@
+"""Policy serving: batched Q-network inference with hot param reload.
+
+The training half of Ape-X broadcasts learner params to actor fleets
+(runtime/param_store.py) that amortize one jitted forward over a whole
+fleet (actors/pool.py).  This package mounts the *inference* half on the
+same two seams: a dynamic micro-batcher coalesces concurrent client
+requests into fixed-bucket batches for one jitted ``argmax Q(s,.)`` call,
+and a reload thread polls any ``ParamSource`` — a live trainer's
+``ParamStore`` or a checkpoint dir — swapping params atomically between
+batches, so a training run and a serving tier share one process with zero
+dropped requests on update.
+
+Public surface:
+  * :class:`PolicyServer` — submit/act + hot reload + serving metrics;
+  * :class:`MicroBatcher` — the bucket-padding deadline batcher;
+  * :class:`CheckpointParamSource` — ParamSource over a checkpoint dir;
+  * typed admission errors: :class:`ServerOverloaded`, :class:`ServerClosed`.
+"""
+
+from ape_x_dqn_tpu.serving.batcher import (
+    MicroBatcher,
+    ServedAction,
+    ServerClosed,
+    ServerOverloaded,
+    ServingError,
+    bucket_for,
+    bucket_sizes,
+)
+from ape_x_dqn_tpu.serving.server import PolicyServer
+from ape_x_dqn_tpu.serving.sources import CheckpointParamSource
+
+__all__ = [
+    "CheckpointParamSource",
+    "MicroBatcher",
+    "PolicyServer",
+    "ServedAction",
+    "ServerClosed",
+    "ServerOverloaded",
+    "ServingError",
+    "bucket_for",
+    "bucket_sizes",
+]

@@ -1,0 +1,221 @@
+"""PolicyServer: batcher + jitted greedy apply + hot param reload + metrics.
+
+Composition (one arrow per thread boundary):
+
+    clients --submit--> MicroBatcher --bucket batch--> greedy_apply(params)
+                                          ^
+    ParamSource (ParamStore | checkpoint dir) <--poll-- reload thread
+
+The reload thread polls ``source.get(have_version)`` — the SAME ParamSource
+protocol actor fleets use (actors/pool.py sync_params) — and swaps the
+``(device_params, version, swap_time)`` triple in one reference assignment.
+The batch worker reads that triple exactly once per batch, so every reply
+in a batch carries the version that actually produced it and a swap can
+never land mid-batch: hot reload with zero dropped requests is structural,
+not scheduled.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ape_x_dqn_tpu.models.dueling import build_greedy_apply
+from ape_x_dqn_tpu.serving.batcher import MicroBatcher, ServedAction
+
+
+class PolicyServer:
+    """Multi-client greedy-action service over one Q-network.
+
+    Args:
+      network: the flax Q-network (models/dueling.py).
+      params: initial host/device params; None pulls the first snapshot
+        from ``param_source`` (blocking up to ``source_timeout_s``).
+      param_source: optional ``get(have_version) -> (params, version) | None``
+        provider (runtime ParamStore, serving CheckpointParamSource, or a
+        test stub); polled every ``reload_poll_s`` while running.
+      max_batch / max_wait_ms / queue_capacity: batcher knobs (see
+        serving/batcher.py for the bucket/deadline/load-shed disciplines).
+    """
+
+    def __init__(
+        self,
+        network,
+        params: Optional[Any] = None,
+        *,
+        param_source: Optional[Any] = None,
+        max_batch: int = 32,
+        max_wait_ms: float = 5.0,
+        queue_capacity: int = 256,
+        reload_poll_s: float = 0.25,
+        source_timeout_s: float = 30.0,
+    ):
+        import jax
+
+        self._jax = jax
+        self.network = network
+        self._apply = build_greedy_apply(network)
+        self._source = param_source
+        self._reload_poll_s = float(reload_poll_s)
+        version = 0
+        if params is None:
+            if param_source is None:
+                raise ValueError("need params or param_source")
+            params, version = self._poll_first(param_source, source_timeout_s)
+        # The live triple: swapped by ONE reference assignment (_swap), read
+        # by ONE local bind per batch (_run_batch) — atomic either side.
+        self._live = (jax.device_put(params), int(version), time.monotonic())
+        self.reload_count = 0
+        self._stop = threading.Event()
+        self._batcher = MicroBatcher(
+            self._run_batch,
+            max_batch=max_batch,
+            max_wait_s=max_wait_ms / 1e3,
+            queue_capacity=queue_capacity,
+        )
+        self._reload_thread = (
+            threading.Thread(
+                target=self._reload_loop, name="serve-reload", daemon=True
+            )
+            if param_source is not None
+            else None
+        )
+        self._started = False
+
+    @staticmethod
+    def _poll_first(source, timeout_s: float):
+        """First snapshot: ``get_blocking`` when the source has it (the
+        ParamStore), else a poll loop over the bare protocol."""
+        if hasattr(source, "get_blocking"):
+            return source.get_blocking(timeout=timeout_s)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            got = source.get(-1)
+            if got is not None:
+                return got
+            time.sleep(0.02)
+        raise TimeoutError("param source published nothing within timeout")
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "PolicyServer":
+        if not self._started:
+            self._started = True
+            self._batcher.start()
+            if self._reload_thread is not None:
+                self._reload_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        self._batcher.close()
+        if self._reload_thread is not None and self._reload_thread.is_alive():
+            self._reload_thread.join(timeout=5.0)
+
+    def __enter__(self) -> "PolicyServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def warmup(self, obs_shape) -> None:
+        """Compile every bucket shape before opening the doors — first
+        requests pay queueing, not XLA compilation."""
+        for b in self._batcher.buckets:
+            self._run_batch(np.zeros((b, *obs_shape), np.uint8))
+
+    # -- request path -----------------------------------------------------
+
+    def submit(self, obs):
+        """Non-blocking: Future of ServedAction (typed errors on overload)."""
+        return self._batcher.submit(obs)
+
+    def act(self, obs, timeout: Optional[float] = 10.0) -> ServedAction:
+        """Blocking convenience: one observation -> one ServedAction."""
+        return self._batcher.submit(obs).result(timeout=timeout)
+
+    def _run_batch(self, obs):
+        params, version, _ = self._live      # one coherent snapshot per batch
+        actions, q = self._jax.device_get(self._apply(params, obs))
+        return actions, q, version
+
+    # -- reload path ------------------------------------------------------
+
+    def poll_reload(self) -> bool:
+        """One source poll; True if new params were adopted.  The reload
+        thread calls this on its cadence; tests and idle-loop callers can
+        drive it directly."""
+        got = self._source.get(self._live[1])
+        if got is None:
+            return False
+        params, version = got
+        # Upload OUTSIDE the swap: requests keep being served on the old
+        # params during the transfer; the swap itself is one assignment.
+        device_params = self._jax.device_put(params)
+        self._live = (device_params, int(version), time.monotonic())
+        self.reload_count += 1
+        return True
+
+    def _reload_loop(self) -> None:
+        while not self._stop.wait(self._reload_poll_s):
+            try:
+                self.poll_reload()
+            except Exception:  # noqa: BLE001 — a flaky source must not
+                # kill serving; stale params are the correct degraded mode.
+                pass
+
+    # -- observability ----------------------------------------------------
+
+    @property
+    def param_version(self) -> int:
+        return self._live[1]
+
+    def stats(self) -> dict:
+        """Serving metrics snapshot (the JSONL emit loop's source)."""
+        b = self._batcher
+        _, version, swapped_at = self._live
+        out = {
+            "qps": round(b.served.rate(), 1),
+            "served_total": int(b.served.total),
+            "shed_total": b.shed_count,
+            "error_total": b.error_count,
+            "queue_depth": b.queue_depth,
+            "param_version": version,
+            "param_age_s": round(time.monotonic() - swapped_at, 3),
+            "reloads": self.reload_count,
+            "batch_hist": {str(k): v for k, v in sorted(b.batch_hist.items())},
+            "latency": b.latency.summary(),
+        }
+        # Versions behind the source (publishes missed): staleness as the
+        # param store defines it, from the serving side.
+        if self._source is not None and hasattr(self._source, "version"):
+            out["versions_behind"] = max(
+                0, int(self._source.version) - version
+            )
+        return out
+
+    def emit_metrics(self, logger, **extra) -> dict:
+        """Flush a serving record onto a utils.metrics.MetricLogger JSONL
+        stream under the ``serve/`` namespace."""
+        s = self.stats()
+        logger.log("serve/qps", s["qps"])
+        logger.log("serve/queue_depth", s["queue_depth"])
+        logger.log("serve/param_version", s["param_version"])
+        logger.log("serve/param_age_s", s["param_age_s"])
+        lat = s["latency"]
+        if lat.get("count"):
+            logger.log("serve/p50_ms", lat["p50_ms"])
+            logger.log("serve/p95_ms", lat["p95_ms"])
+            logger.log("serve/p99_ms", lat["p99_ms"])
+        return logger.emit(
+            **{
+                "serve/shed_total": s["shed_total"],
+                "serve/served_total": s["served_total"],
+                "serve/reloads": s["reloads"],
+                "serve/batch_hist": s["batch_hist"],
+            },
+            **extra,
+        )

@@ -1,0 +1,49 @@
+"""ParamSources beyond the live ParamStore: serve from checkpoints on disk.
+
+The serving tier mounts the same ``get(have_version) -> (params, version)``
+protocol the actor fleets poll (actors/pool.py), so "attach to a live
+trainer" and "watch a checkpoint dir" are the same server wiring with a
+different source plugged in.  Here: the checkpoint-dir source, keyed on
+``utils/checkpoint.latest_step`` — orbax commits atomically (tmp dir +
+rename), so a half-written checkpoint is never visible as a new version.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from ape_x_dqn_tpu.utils.checkpoint import latest_step, restore_checkpoint
+
+
+class CheckpointParamSource:
+    """ParamSource over a checkpoint root dir; version == training step.
+
+    ``state_template`` supplies the TrainState structure/dtypes for the
+    orbax restore (an initialized state from runtime/components
+    ``build_components`` — the same template resume uses).  Only the
+    ``params`` leaf leaves this object: the serving tier never holds the
+    optimizer state or target net in memory.
+    """
+
+    def __init__(self, root: str, state_template):
+        self.root = root
+        self._template = state_template
+
+    @property
+    def version(self) -> int:
+        """Newest committed step (-1 when the dir is empty) — lets the
+        server report versions_behind against the dir."""
+        step = latest_step(self.root)
+        return -1 if step is None else int(step)
+
+    def get(self, have_version: int = -1) -> Optional[Tuple[Any, int]]:
+        import jax
+
+        step = latest_step(self.root)
+        if step is None or step <= have_version:
+            return None
+        # restore_checkpoint re-resolves the newest committed step itself,
+        # so a checkpoint landing between the probe above and the restore
+        # just means we come back one version fresher than probed.
+        state, restored_step = restore_checkpoint(self.root, self._template)
+        return jax.device_get(state.params), int(restored_step)

@@ -12,6 +12,7 @@ for the north-star throughput numbers (learner steps/sec, actor FPS).
 from __future__ import annotations
 
 import json
+import math
 import sys
 import threading
 import time
@@ -57,6 +58,83 @@ class RateCounter:
             # chunk landing 0.5 s ago would read as 16k/s.
             span = max(min(self._window, now - self._born), 1e-9)
             return sum(n for _, n in self._events) / span
+
+
+class LatencyHistogram:
+    """Log-bucketed latency histogram — p50/p95/p99 without storing samples.
+
+    Fixed geometric buckets (``per_decade`` per power of ten between
+    ``min_s`` and ``max_s``) give O(1) record on the serving hot path and
+    bounded relative error on reported percentiles (one bucket width,
+    ~12% at the default 20/decade) — the standard Prometheus-style trade.
+    Thread-safe: many client/worker threads record into one histogram.
+    """
+
+    def __init__(self, min_s: float = 1e-5, max_s: float = 120.0,
+                 per_decade: int = 20):
+        self._min = float(min_s)
+        self._per = int(per_decade)
+        n = int(math.ceil(math.log10(max_s / min_s) * per_decade))
+        # Bucket 0 is underflow (< min_s); bucket i >= 1 covers
+        # [min_s * 10**((i-1)/per), min_s * 10**(i/per)); the last bucket
+        # absorbs overflow.
+        self._counts = [0] * (n + 2)
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        s = float(seconds)
+        if s < self._min:
+            i = 0
+        else:
+            i = min(
+                1 + int(math.log10(s / self._min) * self._per),
+                len(self._counts) - 1,
+            )
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += s
+            if s > self._max:
+                self._max = s
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def percentile(self, p: float) -> float:
+        """Upper edge of the bucket holding the p-th percentile (seconds),
+        clamped to the observed max; NaN when empty."""
+        with self._lock:
+            if self._count == 0:
+                return float("nan")
+            rank = max(1, math.ceil(p / 100.0 * self._count))
+            cum = 0
+            for i, c in enumerate(self._counts):
+                cum += c
+                if cum >= rank:
+                    if i == 0:
+                        return min(self._min, self._max)
+                    return min(self._min * 10 ** (i / self._per), self._max)
+            return self._max
+
+    def summary(self) -> dict:
+        """{count, mean_ms, p50_ms, p95_ms, p99_ms, max_ms} snapshot."""
+        with self._lock:
+            count, total, mx = self._count, self._sum, self._max
+        if count == 0:
+            return {"count": 0}
+        return {
+            "count": count,
+            "mean_ms": round(total / count * 1e3, 3),
+            "p50_ms": round(self.percentile(50) * 1e3, 3),
+            "p95_ms": round(self.percentile(95) * 1e3, 3),
+            "p99_ms": round(self.percentile(99) * 1e3, 3),
+            "max_ms": round(mx * 1e3, 3),
+        }
 
 
 class MetricLogger:

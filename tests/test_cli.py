@@ -64,9 +64,10 @@ def test_bad_override_exits_with_error():
 
 
 def test_canonical_configs_load_and_validate():
-    """The five BASELINE.md canonical configs parse, validate, and carry the
-    runtime modes they claim (device replay, data parallel, process actors,
-    frame compression)."""
+    """The committed canonical configs (the five BASELINE.md training
+    profiles + the serving profile) parse, validate, and carry the runtime
+    modes they claim (device replay, data parallel, process actors,
+    frame compression, serving buckets)."""
     import glob
     import os
 
@@ -74,7 +75,7 @@ def test_canonical_configs_load_and_validate():
 
     root = os.path.join(os.path.dirname(__file__), "..", "configs")
     paths = sorted(glob.glob(os.path.join(root, "*.json")))
-    assert len(paths) == 5, paths
+    assert len(paths) == 6, paths
     cfgs = {os.path.basename(p): load_config(p) for p in paths}
     assert cfgs["config1_pong_1actor.json"].actor.num_actors == 1
     assert cfgs["config2_breakout_8actors.json"].actor.num_actors == 8
@@ -102,6 +103,10 @@ def test_canonical_configs_load_and_validate():
     assert c4.learner.device_replay and c4.learner.sample_ahead
     c5 = cfgs["config5_sweep_atari57_base.json"]
     assert c5.learner.device_replay
+    c6 = cfgs["config6_serving_cpu.json"]
+    assert c6.network == "conv"
+    assert c6.serving.max_batch == 32
+    assert c6.serving.queue_capacity >= c6.serving.max_batch
 
 
 def test_sweep_runner_shared_schedule(tmp_path):

@@ -1,0 +1,407 @@
+"""Policy-serving subsystem (serving/): bucket padding, deadline flush,
+admission control, hot-reload atomicity, checkpoint source, metrics.
+
+The ISSUE-pinned behaviors: padded rows never influence real rows' argmax;
+a lone request flushes at the max-wait deadline (not never); a full queue
+sheds with the typed error (not unbounded growth); a param swap lands
+between batches — every reply's version matches the params that actually
+produced its Q-values.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.models.dueling import build_network
+from ape_x_dqn_tpu.runtime.param_store import ParamStore
+from ape_x_dqn_tpu.serving import (
+    MicroBatcher,
+    PolicyServer,
+    ServerClosed,
+    ServerOverloaded,
+    bucket_for,
+    bucket_sizes,
+)
+from ape_x_dqn_tpu.utils.metrics import LatencyHistogram
+
+OBS = (6,)
+A = 3
+
+
+def make_net_and_params(seed=0):
+    import jax
+
+    net = build_network("mlp", A, hidden_sizes=(16,))
+    params = net.init(jax.random.PRNGKey(seed), np.zeros((1, *OBS), np.uint8))
+    return net, params
+
+
+def ref_q(net, params, obs):
+    """Batch-1 reference forward — the oracle every served row must match."""
+    return np.asarray(net.apply(params, obs[None])[2][0])
+
+
+class TestBuckets:
+    def test_bucket_ladder(self):
+        assert bucket_sizes(1) == [1]
+        assert bucket_sizes(8) == [1, 2, 4, 8]
+        assert bucket_sizes(32) == [1, 2, 4, 8, 16, 32]
+        # Non-power-of-two max always included as the top bucket.
+        assert bucket_sizes(12) == [1, 2, 4, 8, 12]
+
+    def test_bucket_for(self):
+        buckets = bucket_sizes(8)
+        assert bucket_for(1, buckets) == 1
+        assert bucket_for(3, buckets) == 4
+        assert bucket_for(8, buckets) == 8
+        with pytest.raises(ValueError):
+            bucket_for(9, buckets)
+
+
+class TestPaddingCorrectness:
+    def test_padded_rows_never_influence_real_rows(self):
+        """5 concurrent requests ride one bucket-8 batch (3 padded rows);
+        every reply's action and Q must equal the batch-1 oracle."""
+        net, params = make_net_and_params()
+        server = PolicyServer(
+            net, params, max_batch=8, max_wait_ms=100.0, queue_capacity=16
+        )
+        server.warmup(OBS)
+        server.start()
+        try:
+            rng = np.random.default_rng(3)
+            obs = [rng.integers(0, 255, OBS, dtype=np.uint8) for _ in range(5)]
+            futures = [server.submit(o) for o in obs]
+            results = [f.result(timeout=10.0) for f in futures]
+            # All five coalesced into one batch (the 100 ms deadline was
+            # plenty for five same-thread submits).
+            assert server.stats()["batch_hist"].get("5") == 1
+            for o, r in zip(obs, results):
+                q = ref_q(net, params, o)
+                np.testing.assert_allclose(r.q_values, q, atol=1e-4)
+                assert r.action == int(np.argmax(q))
+        finally:
+            server.close()
+
+    def test_every_bucket_shape_matches_oracle(self):
+        """Each bucket size (1, 2, 4, 8) with its padding produces
+        per-row-correct argmax — no shape's compiled program leaks padding
+        into real rows."""
+        net, params = make_net_and_params()
+        server = PolicyServer(
+            net, params, max_batch=8, max_wait_ms=50.0, queue_capacity=16
+        )
+        server.warmup(OBS)
+        server.start()
+        rng = np.random.default_rng(11)
+        try:
+            for n in (1, 2, 3, 5, 8):
+                obs = [
+                    rng.integers(0, 255, OBS, dtype=np.uint8)
+                    for _ in range(n)
+                ]
+                results = [
+                    f.result(timeout=10.0)
+                    for f in [server.submit(o) for o in obs]
+                ]
+                for o, r in zip(obs, results):
+                    assert r.action == int(np.argmax(ref_q(net, params, o)))
+        finally:
+            server.close()
+
+
+class TestDeadlineFlush:
+    def test_lone_request_flushes_at_deadline(self):
+        """At QPS ~0 a single request must complete in ~max_wait, not wait
+        for a full bucket that is never coming."""
+        net, params = make_net_and_params()
+        server = PolicyServer(
+            net, params, max_batch=32, max_wait_ms=30.0, queue_capacity=16
+        )
+        server.warmup(OBS)
+        server.start()
+        try:
+            t0 = time.monotonic()
+            res = server.act(np.zeros(OBS, np.uint8), timeout=10.0)
+            wall = time.monotonic() - t0
+            assert res.action in range(A)
+            # Generous bound for a contended CI host: deadline (30 ms) +
+            # one batch-1 apply + scheduler noise, nowhere near "forever".
+            assert wall < 2.0, f"lone request took {wall:.3f}s"
+            assert server.stats()["batch_hist"].get("1") >= 1
+        finally:
+            server.close()
+
+
+class TestAdmissionControl:
+    def test_load_shed_at_queue_capacity(self):
+        """Queue full -> typed ServerOverloaded, shed counted, and queued
+        requests still complete once the worker unblocks."""
+        release = threading.Event()
+        entered = threading.Event()
+
+        def blocking_run(obs):
+            entered.set()
+            release.wait(timeout=10.0)
+            n = obs.shape[0]
+            return np.zeros(n, np.int32), np.zeros((n, A), np.float32), 0
+
+        b = MicroBatcher(
+            blocking_run, max_batch=1, max_wait_s=0.0, queue_capacity=3
+        )
+        b.start()
+        first = b.submit(np.zeros(OBS, np.uint8))
+        assert entered.wait(timeout=5.0)        # worker holds request #0
+        queued = [b.submit(np.zeros(OBS, np.uint8)) for _ in range(3)]
+        with pytest.raises(ServerOverloaded):
+            b.submit(np.zeros(OBS, np.uint8))   # 4th: queue full -> shed
+        assert b.shed_count == 1
+        release.set()
+        for f in [first, *queued]:
+            assert f.result(timeout=10.0).action == 0
+        assert b.shed_count == 1               # shed didn't double-count
+        b.close()
+
+    def test_closed_server_rejects_typed(self):
+        net, params = make_net_and_params()
+        server = PolicyServer(net, params, max_batch=2, queue_capacity=4)
+        server.start()
+        server.close()
+        with pytest.raises(ServerClosed):
+            server.submit(np.zeros(OBS, np.uint8))
+
+
+class TestHotReload:
+    def test_version_swap_atomicity(self):
+        """Every reply's reported version matches the params that actually
+        computed its Q-values — a swap can land only between batches, and
+        no request is dropped or errored across it."""
+        import jax
+
+        net, p0 = make_net_and_params(seed=0)
+        _, p1 = make_net_and_params(seed=1)
+        by_version = {0: jax.device_get(p0), 1: jax.device_get(p1)}
+        store = ParamStore(p0)
+        server = PolicyServer(
+            net, param_source=store, max_batch=4, max_wait_ms=2.0,
+            queue_capacity=64, reload_poll_s=0.02,
+        )
+        server.warmup(OBS)
+        server.start()
+        results = []          # (obs, ServedAction)
+        errors = []
+        stop = threading.Event()
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                obs = rng.integers(0, 255, OBS, dtype=np.uint8)
+                try:
+                    results.append((obs, server.act(obs, timeout=10.0)))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            store.publish(p1)                   # the hot swap
+            deadline = time.monotonic() + 5.0
+            while server.param_version < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert server.param_version == 1, "reload never adopted"
+            time.sleep(0.3)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+            server.close()
+        assert not errors, f"requests dropped/errored across swap: {errors[:3]}"
+        seen = {r.param_version for _, r in results}
+        assert seen == {0, 1}, f"expected traffic on both versions, saw {seen}"
+        # Batched oracle per version (one forward per version, not one
+        # trace per reply): every reply's Q must match the params of the
+        # version it CLAIMS served it — a torn/mixed swap cannot pass.
+        for version, params in by_version.items():
+            group = [(o, r) for o, r in results if r.param_version == version]
+            obs_batch = np.stack([o for o, _ in group])
+            q_ref = np.asarray(net.apply(params, obs_batch)[2])
+            q_got = np.stack([r.q_values for _, r in group])
+            np.testing.assert_allclose(
+                q_got, q_ref, atol=1e-4,
+                err_msg="replies' q_values disagree with their reported "
+                "version's params — torn/mixed swap",
+            )
+            actions = np.array([r.action for _, r in group])
+            np.testing.assert_array_equal(actions, np.argmax(q_ref, axis=-1))
+        assert server.reload_count == 1
+
+
+class TestCheckpointSource:
+    def test_checkpoint_dir_versions(self, tmp_path):
+        import jax
+
+        from ape_x_dqn_tpu.learner.train_step import (
+            init_train_state,
+            make_optimizer,
+        )
+        from ape_x_dqn_tpu.serving import CheckpointParamSource
+        from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
+
+        net, _ = make_net_and_params()
+        opt = make_optimizer("adam")
+        state = init_train_state(
+            net, opt, jax.random.PRNGKey(0), np.zeros((1, *OBS), np.uint8)
+        )
+        source = CheckpointParamSource(str(tmp_path), state)
+        assert source.version == -1
+        assert source.get(-1) is None           # empty dir: nothing to serve
+        save_checkpoint(str(tmp_path), state)   # step 0
+        got = source.get(-1)
+        assert got is not None
+        params, version = got
+        assert version == 0
+        np.testing.assert_allclose(
+            jax.tree_util.tree_leaves(params)[0],
+            jax.tree_util.tree_leaves(jax.device_get(state.params))[0],
+        )
+        assert source.get(0) is None            # already current
+        newer = state.replace(step=state.step + 7)
+        save_checkpoint(str(tmp_path), newer)   # step 7 commits
+        params, version = source.get(0)
+        assert version == 7
+        assert source.version == 7
+
+
+class TestLatencyHistogram:
+    def test_percentiles_within_bucket_error(self):
+        h = LatencyHistogram()
+        rng = np.random.default_rng(0)
+        samples = rng.uniform(0.001, 0.1, size=5000)
+        for s in samples:
+            h.record(s)
+        for p in (50, 95, 99):
+            exact = float(np.percentile(samples, p))
+            got = h.percentile(p)
+            # One geometric bucket of relative error (20/decade ~ 12%).
+            assert exact * 0.85 <= got <= exact * 1.15, (p, exact, got)
+        s = h.summary()
+        assert s["count"] == 5000
+        assert s["p50_ms"] <= s["p95_ms"] <= s["p99_ms"] <= s["max_ms"]
+
+    def test_empty_and_clamp(self):
+        h = LatencyHistogram()
+        assert h.summary() == {"count": 0}
+        h.record(0.020)
+        # A single sample: every percentile clamps to the observed max.
+        assert h.percentile(50) == pytest.approx(0.020, rel=0.15)
+        assert h.percentile(99) <= 0.020 + 1e-9
+
+
+class TestServingConfig:
+    def test_validation(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+
+        cfg = ApexConfig()
+        cfg.serving.max_batch = 64
+        cfg.serving.queue_capacity = 32     # < max_batch: not admissible
+        with pytest.raises(ValueError, match="queue_capacity"):
+            cfg.validate()
+
+    def test_native_json_and_overrides(self, tmp_path):
+        import json
+
+        from ape_x_dqn_tpu.config import load_config
+
+        f = tmp_path / "cfg.json"
+        f.write_text(json.dumps({
+            "env": {"name": "chain:6"}, "network": "mlp",
+            "serving": {"max_batch": 16, "max_wait_ms": 2.5},
+        }))
+        cfg = load_config(str(f), overrides=["serving.queue_capacity=99"])
+        assert cfg.serving.max_batch == 16
+        assert cfg.serving.max_wait_ms == 2.5
+        assert cfg.serving.queue_capacity == 99
+
+
+class TestLoadgen:
+    def test_quick_closed_loop_run(self):
+        import os
+        import sys
+
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "tools")
+        )
+        try:
+            from loadgen import run_loadgen
+        finally:
+            sys.path.pop(0)
+        r = run_loadgen(
+            clients=4, duration=0.6, network="mlp", obs_shape=OBS,
+            max_batch=8, seq_seconds=0.3, reloads=1, low_qps_requests=3,
+        )
+        assert r["concurrent"]["errors"] == 0
+        assert r["concurrent"]["shed"] == 0
+        assert r["concurrent"]["requests"] > 0
+        assert r["reloads"]["observed"] >= 1
+        assert r["checks"]["hot_reload_zero_dropped"]
+        assert set(r["checks"]) == {
+            "speedup_ge_5x", "hot_reload_zero_dropped",
+            "p99_bounded", "low_qps_bounded",
+        }
+
+
+class TestServeCLI:
+    def test_checkpoint_serve_smoke(self, tmp_path, capsys):
+        """serve CLI end to end: checkpoint dir -> PolicyServer -> built-in
+        clients -> serve/ metrics JSONL on stdout."""
+        import json
+
+        import jax
+
+        from ape_x_dqn_tpu.config import ApexConfig
+        from ape_x_dqn_tpu.learner.train_step import (
+            init_train_state,
+            make_optimizer,
+        )
+        from ape_x_dqn_tpu.serve import main
+        from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
+
+        cfg = ApexConfig()
+        cfg.env.name = "chain:6"
+        cfg.network = "mlp"
+        from ape_x_dqn_tpu.runtime.components import build_components
+
+        comps = build_components(cfg)
+        save_checkpoint(str(tmp_path), comps.state)
+        rc = main([
+            "--checkpoint", str(tmp_path),
+            "--set", "env.name=chain:6", "--set", "network=mlp",
+            "--clients", "2", "--duration", "1.0",
+            "--metrics-every", "0.4",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        records = [json.loads(l) for l in out.splitlines() if l.strip()]
+        assert records, "no metrics emitted"
+        final = records[-1]
+        assert final.get("final")
+        assert final["serve/served_total"] > 0
+        assert final["serve/shed_total"] == 0
+        assert any("serve/qps" in r for r in records)
+
+    def test_empty_checkpoint_dir_is_an_error(self, tmp_path):
+        from ape_x_dqn_tpu.serve import main
+
+        rc = main([
+            "--checkpoint", str(tmp_path / "none"),
+            "--set", "env.name=chain:6", "--set", "network=mlp",
+            "--duration", "0.2",
+        ])
+        assert rc == 2

@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for the policy-serving subsystem.
+
+N client threads drive an in-process PolicyServer (serving/server.py) in
+closed loop — each client submits one observation, waits for its action,
+optionally thinks, repeats — the standard shape for measuring a batching
+service honestly (open-loop generators overstate a coalescing server's
+latency and understate its throughput).
+
+Four phases, one JSON artifact:
+  1. **sequential** — batch-1 jitted apply in a plain loop: the throughput
+     a client gets WITHOUT the serving tier (the 5x claim's denominator);
+  2. **concurrent** — N clients against the server, with ``--reloads`` hot
+     param swaps published mid-run (the zero-dropped-on-reload claim);
+  3. **low-qps** — a lone client with think time: latency must be bounded
+     by the max-wait deadline + one batch-1 apply (the p99 bound claim);
+  4. a ``checks`` block asserting all three claims machine-readably.
+
+Usage:
+    python tools/loadgen.py --clients 32 --duration 6 \
+        --out demos/serving_loadgen.json
+The result JSON is always printed as the LAST stdout line (bench.py's
+``serving_qps`` section parses it from a CPU-pinned subprocess).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse_obs(spec: str):
+    return tuple(int(d) for d in spec.lower().split("x"))
+
+
+def run_loadgen(
+    clients: int = 32,
+    duration: float = 6.0,
+    think_ms: float = 0.0,
+    network: str = "conv",
+    obs_shape=(84, 84, 1),
+    num_actions: int = 4,
+    max_batch: int = 32,
+    max_wait_ms: float = 5.0,
+    queue_capacity: int = 256,
+    seq_seconds: float = 3.0,
+    reloads: int = 2,
+    low_qps_requests: int = 20,
+    seed: int = 0,
+) -> dict:
+    import jax
+    import numpy as np
+
+    from ape_x_dqn_tpu.models.dueling import build_greedy_apply, build_network
+    from ape_x_dqn_tpu.runtime.param_store import ParamStore
+    from ape_x_dqn_tpu.serving import PolicyServer
+
+    net = build_network(network, num_actions)
+    rng = np.random.default_rng(seed)
+    dummy = np.zeros((1, *obs_shape), np.uint8)
+    params0 = net.init(jax.random.PRNGKey(seed), dummy)
+    store = ParamStore(params0)
+
+    server = PolicyServer(
+        net,
+        param_source=store,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        queue_capacity=queue_capacity,
+        reload_poll_s=0.1,
+    )
+    server.warmup(obs_shape)
+    server.start()
+
+    # -- phase 1: sequential batch-1 baseline (no serving tier) -----------
+    apply_fn = build_greedy_apply(net)
+    params_dev = jax.device_put(jax.device_get(params0))
+    obs1 = rng.integers(0, 255, (1, *obs_shape), dtype=np.uint8)
+    jax.device_get(apply_fn(params_dev, obs1))  # compile outside the clock
+    obs_big = np.broadcast_to(obs1, (max_batch, *obs_shape))
+    jax.device_get(apply_fn(params_dev, obs_big))
+    t0 = time.perf_counter()
+    seq_requests = 0
+    while time.perf_counter() - t0 < seq_seconds:
+        obs = rng.integers(0, 255, (1, *obs_shape), dtype=np.uint8)
+        jax.device_get(apply_fn(params_dev, obs))
+        seq_requests += 1
+    seq_wall = time.perf_counter() - t0
+    seq_qps = seq_requests / seq_wall
+    single_apply_ms = seq_wall / max(seq_requests, 1) * 1e3
+    # One full-bucket batch's compute (for the p99 bound arithmetic).
+    t0 = time.perf_counter()
+    reps = 5
+    for _ in range(reps):
+        jax.device_get(apply_fn(params_dev, obs_big))
+    batch_apply_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    # -- phase 2: concurrent clients + hot reloads mid-run -----------------
+    stop = threading.Event()
+    counts = [0] * clients
+    shed_errors = [0] * clients
+    other_errors = [0] * clients
+
+    def client(i: int) -> None:
+        from ape_x_dqn_tpu.serving import ServerOverloaded
+
+        crng = np.random.default_rng(seed + 1000 + i)
+        while not stop.is_set():
+            obs = crng.integers(0, 255, obs_shape, dtype=np.uint8)
+            try:
+                server.act(obs, timeout=60.0)
+                counts[i] += 1
+            except ServerOverloaded:
+                shed_errors[i] += 1
+            except Exception:  # noqa: BLE001 — counted, loop continues
+                other_errors[i] += 1
+            if think_ms > 0:
+                time.sleep(think_ms / 1e3)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    served_before = server.stats()["served_total"]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    # Publish `reloads` fresh param sets spread across the run — the
+    # training side of hot reload, compressed: each publish is exactly what
+    # the learner's capped-rate publish does (runtime/param_store.py).
+    for r in range(reloads):
+        time.sleep(duration / (reloads + 1))
+        fresh = net.init(jax.random.PRNGKey(seed + 7919 * (r + 1)), dummy)
+        store.publish(fresh)
+    time.sleep(max(0.0, duration - (time.perf_counter() - t0)))
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    conc_wall = time.perf_counter() - t0
+    stats = server.stats()
+    conc_requests = sum(counts)
+    conc_qps = conc_requests / conc_wall
+
+    # -- phase 3: low-QPS deadline bound -----------------------------------
+    low_lat_ms = []
+    lrng = np.random.default_rng(seed + 5)
+    for _ in range(low_qps_requests):
+        obs = lrng.integers(0, 255, obs_shape, dtype=np.uint8)
+        res = server.act(obs, timeout=30.0)
+        low_lat_ms.append(res.latency_s * 1e3)
+        time.sleep(0.02)
+    server.close()
+
+    speedup = conc_qps / max(seq_qps, 1e-9)
+    p99_ms = stats["latency"].get("p99_ms", float("nan"))
+    # Bounds: a lone request may wait the full deadline then one batch-1
+    # apply; a loaded request at worst queues behind one in-flight bucket
+    # then rides the next (deadline + 2 bucket applies), with scheduler
+    # margin on a contended host.
+    low_bound_ms = max_wait_ms + 4 * single_apply_ms + 50.0
+    p99_bound_ms = max_wait_ms + 4 * batch_apply_ms + 100.0
+    result = {
+        "config": {
+            "clients": clients,
+            "duration_s": duration,
+            "think_ms": think_ms,
+            "network": network,
+            "obs_shape": list(obs_shape),
+            "num_actions": num_actions,
+            "max_batch": max_batch,
+            "max_wait_ms": max_wait_ms,
+            "queue_capacity": queue_capacity,
+            "buckets": server._batcher.buckets,
+            "platform": jax.devices()[0].platform,
+        },
+        "sequential": {
+            "qps": round(seq_qps, 1),
+            "requests": seq_requests,
+            "seconds": round(seq_wall, 2),
+            "single_apply_ms": round(single_apply_ms, 3),
+            "batch_apply_ms": round(batch_apply_ms, 3),
+        },
+        "concurrent": {
+            "qps": round(conc_qps, 1),
+            "requests": conc_requests,
+            "served_by_server": stats["served_total"] - served_before,
+            "seconds": round(conc_wall, 2),
+            "latency": stats["latency"],
+            "batch_hist": stats["batch_hist"],
+            "shed": sum(shed_errors),
+            "errors": sum(other_errors),
+        },
+        "speedup": round(speedup, 2),
+        "reloads": {
+            "requested": reloads,
+            "observed": server.reload_count,
+            "final_version": server.param_version,
+        },
+        "low_qps": {
+            "requests": low_qps_requests,
+            "max_ms": round(max(low_lat_ms), 3) if low_lat_ms else None,
+            "mean_ms": round(sum(low_lat_ms) / len(low_lat_ms), 3)
+            if low_lat_ms else None,
+            "deadline_ms": max_wait_ms,
+            "bound_ms": round(low_bound_ms, 3),
+        },
+        "checks": {
+            "speedup_ge_5x": bool(speedup >= 5.0),
+            "hot_reload_zero_dropped": bool(
+                server.reload_count >= min(1, reloads)
+                and sum(other_errors) == 0
+                and sum(shed_errors) == 0
+            ),
+            "p99_bounded": bool(p99_ms <= p99_bound_ms),
+            "low_qps_bounded": bool(
+                not low_lat_ms or max(low_lat_ms) <= low_bound_ms
+            ),
+        },
+    }
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--clients", type=int, default=32)
+    p.add_argument("--duration", type=float, default=6.0)
+    p.add_argument("--think-ms", type=float, default=0.0)
+    p.add_argument("--network", default="conv",
+                   choices=("conv", "nature", "mlp"))
+    p.add_argument("--obs", default="84x84x1", help="observation shape HxWxC")
+    p.add_argument("--num-actions", type=int, default=4)
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--max-wait-ms", type=float, default=5.0)
+    p.add_argument("--queue-capacity", type=int, default=256)
+    p.add_argument("--seq-seconds", type=float, default=3.0)
+    p.add_argument("--reloads", type=int, default=2)
+    p.add_argument("--low-qps-requests", type=int, default=20)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. 'cpu') BEFORE backend init — how "
+        "bench.py runs this host-only during a TPU-tunnel outage",
+    )
+    p.add_argument("--out", default=None, help="write the result JSON here")
+    args = p.parse_args(argv)
+
+    if args.platform:
+        # Must land before any jax backend initializes (run_loadgen does
+        # the jax imports); jax.config outranks the env var on images whose
+        # sitecustomize pins a TPU plugin (same bootstrap as tests/conftest).
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    result = run_loadgen(
+        clients=args.clients,
+        duration=args.duration,
+        think_ms=args.think_ms,
+        network=args.network,
+        obs_shape=_parse_obs(args.obs),
+        num_actions=args.num_actions,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_capacity=args.queue_capacity,
+        seq_seconds=args.seq_seconds,
+        reloads=args.reloads,
+        low_qps_requests=args.low_qps_requests,
+        seed=args.seed,
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Tier-1 verify — the ONE blessed entry point for builders and CI.
+# This is the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
+# command changes, change it HERE too (they must stay character-identical
+# modulo this wrapper's cd).
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
