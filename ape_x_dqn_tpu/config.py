@@ -77,6 +77,25 @@ class ActorConfig:
     # CPU inference (measured on a 1-core VM: nice-0 workers starve the
     # fused learner ~7x below its solo rate).  0 = scheduler default.
     worker_nice: int = 0
+    # Experience-transport knobs (mode="process"; runtime/shm_ring.py).
+    # Each worker incarnation gets one SIGKILL-safe shared-memory ring of
+    # xp_ring_bytes: it must hold at least one chunk (a chunk is roughly
+    # flush_every × actors-per-worker × 2 × frame bytes in the dense wire
+    # format; ~half that under replay.dedup) with slack for the learner's
+    # drain cadence — too small and workers sit in ring-full backpressure.
+    # Sizing is part of the fd/shm budget at fleet scale: 256 workers at
+    # the 8 MB default is 2 GB of /dev/shm and ~5 fds per worker
+    # (transport_budget() computes it; ProcessActorPool.start() gates on
+    # the /dev/shm free-space check).
+    xp_ring_bytes: int = 8 << 20
+    # Per-poll byte budget of the learner's batched ring sweep: bounds how
+    # long one poll can stall the pump thread behind a burst, without
+    # starving any single ring (the sweep round-robins).
+    xp_drain_budget_bytes: int = 64 << 20
+    # Seconds between worker spawns (throttled fleet start): at 256
+    # workers an unthrottled start piles every child's jax import onto the
+    # host at once.  0 = spawn back-to-back.
+    spawn_stagger_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -202,6 +221,14 @@ class ApexConfig:
             (a.num_workers >= 1, "actor.num_workers must be >= 1"),
             (0 <= a.worker_nice <= 19,
              "actor.worker_nice must be in [0, 19]"),
+            (a.xp_ring_bytes >= 1 << 16,
+             "actor.xp_ring_bytes must be >= 64 KiB (one chunk + record "
+             "framing must fit the ring)"),
+            (a.xp_drain_budget_bytes >= 1 << 16,
+             "actor.xp_drain_budget_bytes must be >= 64 KiB (the sweep "
+             "must be able to drain at least one chunk per poll)"),
+            (a.spawn_stagger_s >= 0.0,
+             "actor.spawn_stagger_s must be >= 0"),
             (a.mode != "process" or a.num_actors >= a.num_workers,
              "actor.num_actors must be >= actor.num_workers in process mode"),
             (l.publish_every >= 1, "learner.publish_every must be >= 1"),
@@ -398,3 +425,25 @@ def _from_native_json(data: dict) -> ApexConfig:
 
 def to_dict(cfg: ApexConfig) -> dict:
     return dataclasses.asdict(cfg)
+
+
+def transport_budget(cfg: ApexConfig, num_workers: Optional[int] = None) -> dict:
+    """fd/shm budget of the process-actor experience transport at a given
+    fleet scale — the planning arithmetic for "can this host hold 256
+    workers" (the live twin is ``ProcessActorPool.shm_accounting``).
+
+    Per worker the parent holds: one experience-ring shm segment (1 fd for
+    the mapping), the control ``mp.Queue`` (a pipe pair: 2 fds) plus its
+    feeder-thread wakeup fds, and the process sentinel (1 fd) — ~5 fds.
+    The param seqlock buffer is one more shared segment for the fleet.
+    """
+    w = int(num_workers if num_workers is not None else cfg.actor.num_workers)
+    ring = int(cfg.actor.xp_ring_bytes)
+    return {
+        "workers": w,
+        "shm_segments": w + 1,               # per-worker ring + param buffer
+        "ring_bytes_each": ring,
+        "ring_bytes_total": w * ring,
+        "fds_per_worker": 5,
+        "est_parent_fds": 5 * w + 8,         # + param shm, logs, slack
+    }

@@ -331,8 +331,9 @@ class AsyncPipeline:
         if self.cfg.actor.mode == "process":
             # Actors in CPU-only worker processes: params travel as
             # serialized snapshots through shared memory, experience through
-            # a bounded queue (runtime/process_actors.py — the reference's
-            # N-process actor layout, main.py:50-54).
+            # one SIGKILL-safe shm ring per worker incarnation
+            # (runtime/process_actors.py + runtime/shm_ring.py — the
+            # reference's N-process actor layout, main.py:50-54).
             from ape_x_dqn_tpu.runtime.process_actors import (
                 ProcessActorPool,
                 ProcessActorWorker,
@@ -686,6 +687,15 @@ class AsyncPipeline:
             replay=self.fused,
         )
 
+    def _transport_extra(self) -> dict:
+        """Experience-transport metrics (process-actor shm rings): ingest
+        bytes/s, chunk latency, ring-full backpressure, torn-record salvage
+        — absent in thread mode (no cross-process transport)."""
+        pool = getattr(self.worker, "pool", None)
+        if pool is None or not hasattr(pool, "transport_stats"):
+            return {}
+        return {"xp_transport": pool.transport_stats()}
+
     def _emit_fused(self, metrics, final: bool = False) -> dict:
         import numpy as np
 
@@ -714,6 +724,7 @@ class AsyncPipeline:
             actor_heartbeat_age=round(time.monotonic() - self.worker.heartbeat, 3),
             stage_us=self.timers.us_per_call(),
             final=final,
+            **self._transport_extra(),
         )
 
     def _place(self, host_batch):
@@ -781,4 +792,5 @@ class AsyncPipeline:
             actor_heartbeat_age=round(time.monotonic() - self.worker.heartbeat, 3),
             stage_us=self.timers.us_per_call(),
             final=final,
+            **self._transport_extra(),
         )

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 import jax
@@ -67,20 +68,26 @@ class PrefetchQueue:
             self._error = e
 
     def get(self, timeout: float = 30.0):
-        """Next staged device batch; re-raises feeder errors."""
-        deadline = None
+        """Next staged device batch; re-raises feeder errors.
+
+        ``timeout`` is a wall-clock deadline from CALL ENTRY: the previous
+        spelling only started counting after the first ``queue.Empty`` and
+        waited a flat ``min(0.2, timeout)`` per retry regardless of the
+        remaining budget, so a ``get(10.0)`` could block ~10.2 s and a
+        sub-200 ms timeout overshot by up to a whole retry period.  Each
+        wait is still capped at 0.2 s so feeder errors surface promptly.
+        """
+        deadline = time.monotonic() + timeout
         while True:
             if self._error is not None:
                 raise RuntimeError("infeed feeder failed") from self._error
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("infeed queue starved") from None
             try:
-                return self._q.get(timeout=min(0.2, timeout))
+                return self._q.get(timeout=min(0.2, remaining))
             except queue.Empty:
-                import time
-
-                if deadline is None:
-                    deadline = time.monotonic() + timeout
-                elif time.monotonic() > deadline:
-                    raise TimeoutError("infeed queue starved") from None
+                continue
 
     def stop(self) -> None:
         self._stop.set()

@@ -15,9 +15,16 @@ module is that mode, on the TPU-native transport stack:
     live objects, readers never block the writer.  The same snapshot bytes
     are what a DCN fetch would ship between hosts — the store is the seam
     (runtime/param_store.py).
-  * **Experience transport** — one bounded ``mp.Queue`` carrying numpy
-    chunk payloads (the analogue of the reference's unbounded manager
-    queue, main.py:39, with backpressure by construction).
+  * **Experience transport** — one SIGKILL-safe single-producer/single-
+    consumer shared-memory ring per worker incarnation
+    (``runtime/shm_ring.ShmRing``): workers gather chunks into the ring in
+    the ``utils/serialization`` APXT wire format (numpy frame bytes written
+    once, no pickle), the learner drains every ring in one batched sweep
+    per poll and hands whole chunks to replay ingest as zero-copy views.
+    A worker killed mid-record leaves a detectably torn tail instead of a
+    held lock — the salvage-and-respawn discipline ``mp.Queue`` could only
+    approximate by abandoning a whole queue.  ``mp.Queue`` remains as a
+    low-volume CONTROL channel (done/error/episode stats only).
   * **Worker processes** are CPU-only JAX (pinned via ``jax.config`` — the
     env var is not sufficient on plugin-pinning images — before
     the child imports jax): exactly one process — the learner — owns the
@@ -42,6 +49,14 @@ from multiprocessing import shared_memory
 from typing import Any, List, Optional
 
 import numpy as np
+
+from ape_x_dqn_tpu.runtime.shm_ring import (
+    DXP,
+    XP,
+    ShmRing,
+    decode_chunk,
+    encode_chunk_parts,
+)
 
 _HEADER = struct.Struct("<qqI")  # (seqlock version, payload length, crc32)
 
@@ -262,11 +277,13 @@ def network_and_template(cfg):
 
 
 def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
-                 shm_name: str, shm_capacity: int, xp_queue, stop_evt,
+                 shm_name: str, shm_capacity: int, ring_name: str,
+                 ring_capacity: int, ctl_queue, stop_evt,
                  steps_budget: int, quantum: int, attempt: int = 0,
                  seed_base: int = 0, nice: int = 0):
-    """Worker process entry: CPU-only jax, one ActorFleet slice, pump
-    chunks + episode stats into the experience queue."""
+    """Worker process entry: CPU-only jax, one ActorFleet slice, gather
+    chunks into this incarnation's shm ring; episode stats / completion /
+    errors ride the low-volume control queue."""
     if nice:
         # QoS: on hosts where workers share cores with the learner, a
         # positive niceness keeps the learner's dispatch thread scheduled
@@ -295,6 +312,7 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
 
     _jax.config.update("jax_platforms", "cpu")
     buf = None
+    ring = None
     try:
         from ape_x_dqn_tpu.actors import ActorFleet
         from ape_x_dqn_tpu.envs import make_env
@@ -307,7 +325,7 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
         N = cfg.actor.num_actors
         lo, hi = worker_slice(worker_id, N, num_workers)
         if hi == lo:
-            xp_queue.put(("done", worker_id, 0))
+            ctl_queue.put(("done", worker_id, 0))
             return
         env_kwargs, network, template = network_and_template(cfg)
         env_fns = [
@@ -335,13 +353,14 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
             emit_dedup_groups=_dedup_groups(cfg),
         )
         buf = SharedParamBuffer(shm_capacity, name=shm_name, create=False)
+        ring = ShmRing(ring_capacity, name=ring_name, create=False)
         source = SharedBufferParamSource(buf, template)
         # Wait for the learner's first publication (the reference's
         # construct-learner-first ordering constraint, main.py:44).
         deadline = time.monotonic() + 60.0
         while not fleet.sync_params(source):
             if stop_evt.is_set() or time.monotonic() > deadline:
-                xp_queue.put(("done", worker_id, 0))
+                ctl_queue.put(("done", worker_id, 0))
                 return
             time.sleep(0.01)
         while not stop_evt.is_set() and fleet.step_count < steps_budget:
@@ -353,20 +372,38 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
             )
             for c in chunks:
                 if cfg.replay.dedup:
-                    # DedupChunk is a NamedTuple of arrays + int identity
-                    # fields — ships as a plain dict (types.DedupChunk).
-                    payload = ("dxp", c.transitions._asdict())
+                    # DedupChunk arrays ship as APXT buffers; the int
+                    # identity fields ride the record's metadata prefix.
+                    d = c.transitions._asdict()
+                    parts = encode_chunk_parts(
+                        DXP, fleet.param_version, c.actor_steps,
+                        {
+                            "prio": np.asarray(c.priorities),
+                            **{k: np.asarray(d[k])
+                               for k in ("frames", "obs_ref", "next_ref",
+                                         "action", "reward", "discount")},
+                        },
+                        source=d["source"], chunk_seq=d["chunk_seq"],
+                        prev_frames=d["prev_frames"],
+                    )
                 else:
-                    payload = ("xp", {
-                        f: np.asarray(getattr(c.transitions, f))
-                        for f in ("obs", "action", "reward", "discount",
-                                  "next_obs")})
-                xp_queue.put((
-                    payload[0], worker_id, fleet.param_version,
-                    np.asarray(c.priorities), payload[1], c.actor_steps,
-                ))
+                    parts = encode_chunk_parts(
+                        XP, fleet.param_version, c.actor_steps,
+                        {
+                            "prio": np.asarray(c.priorities),
+                            **{f: np.asarray(getattr(c.transitions, f))
+                               for f in ("obs", "action", "reward",
+                                         "discount", "next_obs")},
+                        },
+                    )
+                # Backpressure: block on a full ring (bounded sleeps, the
+                # learner's drain frees space) but abort promptly on stop —
+                # a stopping learner no longer drains, and unlike mp.Queue
+                # there is no shared lock a kill could strand.
+                if not ring.write(parts, should_stop=stop_evt.is_set):
+                    break
             if stats:
-                xp_queue.put((
+                ctl_queue.put((
                     "episodes", worker_id,
                     [(s.actor_id + lo, s.episode_return, s.episode_length)
                      for s in stats],
@@ -375,33 +412,40 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
             # otherwise grows worker RSS ~0.65 MB/s forever (utils/memory
             # docstring — measured in the round-5 flagship soak).
             trim_malloc()
-        xp_queue.put(("done", worker_id, fleet.step_count))
+        ctl_queue.put(("done", worker_id, fleet.step_count))
     except Exception as e:  # noqa: BLE001 — report, don't hang the join
         try:
-            xp_queue.put(("error", worker_id, f"{type(e).__name__}: {e}"))
+            ctl_queue.put(("error", worker_id, f"{type(e).__name__}: {e}"))
         except Exception:
             pass
     finally:
         if buf is not None:
             buf.close()
+        if ring is not None:
+            ring.close()
 
 
 class ProcessActorPool:
-    """Owner of N actor worker processes + the shared param buffer.
+    """Owner of N actor worker processes + the shared param buffer + one
+    experience shm ring per worker incarnation.
 
     Lifecycle: ``start()`` → learner loop interleaves ``publish(params)``
-    and ``poll()`` → ``stop()``.  ``poll`` drains the experience queue into
-    (priorities, transitions) pairs and accounting.
+    and ``poll()`` → ``stop()``.  ``poll`` drains every ring in one batched
+    sweep (bounded by ``max_items`` and a byte budget) into (priorities,
+    transitions) pairs, and the control queues into accounting.
     """
 
     def __init__(self, cfg, num_workers: int = 2,
                  shm_capacity: Optional[int] = None,
                  queue_size: int = 64, quantum: Optional[int] = None,
-                 max_restarts: int = 3, seed_base: int = 0):
+                 max_restarts: int = 3, seed_base: int = 0,
+                 ring_bytes: Optional[int] = None,
+                 drain_budget_bytes: Optional[int] = None):
         import jax
 
         from ape_x_dqn_tpu.config import to_dict
         from ape_x_dqn_tpu.types import NStepTransition
+        from ape_x_dqn_tpu.utils.metrics import TransportStats
 
         self._NStepTransition = NStepTransition
         self.cfg = cfg
@@ -416,16 +460,27 @@ class ProcessActorPool:
         self.buffer = SharedParamBuffer(shm_capacity)
         self.store = SharedMemoryParamStore(self.buffer)
         self._ctx = mp.get_context("spawn")
-        # One experience queue PER WORKER INCARNATION (replaced on
-        # respawn): mp.Queue is not SIGKILL-safe — a worker killed mid-put
-        # leaves the queue's shared write lock held forever, deadlocking
-        # every other producer on that queue (its own respawn included).
-        # Round-5 finding: the elasticity tests hit this whenever the kill
-        # landed inside a put (probable with fast envs); per-incarnation
-        # queues confine the corruption to the dead incarnation, which is
-        # the only SIGKILL-safe discipline plain mp.Queue admits.
+        # Experience rides one shm ring PER WORKER INCARNATION (replaced on
+        # respawn): the ring is SIGKILL-safe by construction — no locks, a
+        # kill mid-record leaves a detectably torn tail — but a fresh ring
+        # per incarnation keeps the salvage accounting exact and the
+        # respawned worker's stream seq-clean from record zero.  The
+        # mp.Queue survives only as a CONTROL channel (done/error/episode
+        # stats): low-volume, and its round-5 SIGKILL hazard (a worker
+        # killed mid-put strands the queue's shared write lock) is confined
+        # by the same per-incarnation replacement discipline.
         self._queue_size = int(queue_size)
         self._queues: dict = {}
+        self._rings: dict = {}
+        self._ring_bytes = int(
+            ring_bytes if ring_bytes is not None else cfg.actor.xp_ring_bytes
+        )
+        self._drain_budget = int(
+            drain_budget_bytes if drain_budget_bytes is not None
+            else cfg.actor.xp_drain_budget_bytes
+        )
+        self.transport = TransportStats()
+        self._full_waits_base = 0  # full_waits of retired incarnations
         self.stop_event = self._ctx.Event()
         self._cfg_dict = to_dict(cfg)
         self._quantum = quantum or cfg.actor.flush_every
@@ -452,15 +507,14 @@ class ProcessActorPool:
         attempt = self._attempt.get(wid, 0)
         self._attempt[wid] = attempt + 1
         if wid in self._queues:
-            # Salvage whatever the dead incarnation fully enqueued, then
-            # abandon its queue (the write side may hold a dead process's
-            # lock — see __init__'s SIGKILL-safety note).
-            self._drain_queue(self._queues[wid])
+            self._salvage_incarnation(wid)
         self._queues[wid] = self._ctx.Queue(maxsize=self._queue_size)
+        self._rings[wid] = ShmRing(self._ring_bytes)
         p = self._ctx.Process(
             target=_worker_main,
             args=(wid, self._cfg_dict, self.num_workers, self.buffer.name,
-                  self.buffer.capacity, self._queues[wid], self.stop_event,
+                  self.buffer.capacity, self._rings[wid].name,
+                  self._ring_bytes, self._queues[wid], self.stop_event,
                   budget, self._quantum, attempt, self._seed_base,
                   self.cfg.actor.worker_nice),
             daemon=True,
@@ -468,22 +522,87 @@ class ProcessActorPool:
         p.start()
         return p
 
-    def _drain_queue(self, q, limit: int = 4096) -> None:
+    def _salvage_incarnation(self, wid: int) -> None:
+        """Round-5 salvage discipline, on the shm transport: drain every
+        FULLY-COMMITTED record out of the dead incarnation's ring (a kill
+        mid-record leaves a torn tail the commit word detects — counted,
+        never delivered), drain its control queue, then retire both.  The
+        respawn gets a fresh ring, so its stream restarts seq-clean."""
+        self._drain_control(self._queues[wid])
+        ring = self._rings.pop(wid, None)
+        if ring is not None:
+            salvaged = 0
+            while True:
+                rec = ring.read_next()
+                if rec is None:
+                    break
+                self._salvaged.append(self._decode_record(wid, rec))
+                salvaged += 1
+            self.transport.count_salvage(salvaged, torn=ring.torn_tail())
+            self._full_waits_base += ring.full_waits
+            ring.close()
+            ring.unlink()
+        old = self._queues.pop(wid, None)
+        if old is not None:
+            try:  # release the pipe fds now, not at gc (256-worker budget)
+                old.close()
+            except Exception:  # noqa: BLE001 — dead-writer queue teardown
+                pass
+
+    def _drain_control(self, q, limit: int = 4096) -> None:
         import queue as queue_mod
 
         for _ in range(limit):
             try:
-                item = self._dispatch(q.get_nowait())
+                self._dispatch(q.get_nowait())
             except queue_mod.Empty:
                 return
             except Exception:  # torn pickle from a killed mid-put writer
                 return
-            if item is not None:
-                self._salvaged.append(item)
 
-    def start(self):
+    def shm_accounting(self) -> dict:
+        """Live fd/shm usage of the transport (logged by the fleet tools;
+        the config-side planning twin is ``config.transport_budget``)."""
+        import os as _os
+
+        try:
+            n_fds = len(_os.listdir("/proc/self/fd"))
+        except OSError:
+            n_fds = -1
+        return {
+            "shm_segments": 1 + len(self._rings),
+            "ring_bytes_each": self._ring_bytes,
+            "ring_bytes_total": self._ring_bytes * len(self._rings),
+            "param_buffer_bytes": self.buffer.capacity,
+            "process_fds": n_fds,
+        }
+
+    def start(self, stagger_s: Optional[float] = None):
+        """Spawn all workers, optionally throttled (``stagger_s`` seconds
+        between spawns — at 256 workers an unthrottled start piles every
+        child's jax import onto the host at once)."""
+        import os as _os
+
+        stagger = (stagger_s if stagger_s is not None
+                   else self.cfg.actor.spawn_stagger_s)
+        # fd/shm budget gate: fail loudly BEFORE spawning a fleet whose
+        # rings cannot fit /dev/shm (256 workers × ring_bytes is real money).
+        need = self.num_workers * self._ring_bytes + self.buffer.capacity
+        try:
+            st = _os.statvfs("/dev/shm")
+            free = st.f_bavail * st.f_frsize
+        except OSError:
+            free = None
+        if free is not None and need > free:
+            raise RuntimeError(
+                f"experience-transport shm budget {need} bytes exceeds "
+                f"/dev/shm free space {free} — lower actor.xp_ring_bytes "
+                f"or actor.num_workers"
+            )
         for w in range(self.num_workers):
             self._procs.append(self._spawn(w, self.cfg.actor.T))
+            if stagger and w + 1 < self.num_workers:
+                time.sleep(stagger)
 
     def supervise(self) -> None:
         """Respawn dead workers (SURVEY §5 failure detection: actors are
@@ -534,27 +653,40 @@ class ProcessActorPool:
     def finished(self) -> bool:
         return len(self.finished_workers) + len(self.worker_errors) >= self.num_workers
 
-    def poll(self, max_items: int = 64, timeout: float = 0.0) -> List[tuple]:
-        """Drain up to ``max_items`` experience chunks across every live
-        worker queue; returns [(priorities, transitions), ...].  Episode
-        stats / completion / errors update pool state as a side effect."""
+    def poll(self, max_items: int = 64, timeout: float = 0.0,
+             max_bytes: Optional[int] = None) -> List[tuple]:
+        """One batched sweep over every live worker's ring (bounded by
+        ``max_items`` chunks and the byte drain budget) plus the control
+        queues; returns [(priorities, transitions), ...].  Episode stats /
+        completion / errors update pool state as a side effect."""
         import queue as queue_mod
 
         out = list(self._salvaged)
         self._salvaged.clear()
+        budget = max_bytes if max_bytes is not None else self._drain_budget
         deadline = time.monotonic() + timeout if timeout else None
-        while len(out) < max_items:
+        while len(out) < max_items and budget > 0:
             got = False
-            for q in list(self._queues.values()):
-                if len(out) >= max_items:
-                    break
+            for q in list(self._queues.values()):  # control: low volume
                 try:
-                    item = self._dispatch(q.get_nowait())
+                    self._dispatch(q.get_nowait())
+                    got = True
                 except queue_mod.Empty:
                     continue
-                got = True
-                if item is not None:
-                    out.append(item)
+                except Exception:  # torn pickle from a killed mid-put writer
+                    continue
+            for wid, ring in list(self._rings.items()):
+                # Round-robin fairness: a few records per ring per pass, so
+                # one hot worker cannot starve the sweep.
+                for _ in range(4):
+                    if len(out) >= max_items or budget <= 0:
+                        break
+                    rec = ring.read_next()
+                    if rec is None:
+                        break
+                    got = True
+                    budget -= len(rec)
+                    out.append(self._decode_record(wid, rec))
             if not got:
                 if not out and deadline and time.monotonic() < deadline:
                     time.sleep(min(0.01, timeout))
@@ -562,25 +694,48 @@ class ProcessActorPool:
                 break
         return out
 
-    def _dispatch(self, msg):
-        """Apply one worker message to pool state; returns an experience
-        tuple for 'xp'/'dxp' messages, else None."""
-        kind = msg[0]
-        if kind in ("xp", "dxp"):
-            _, wid, version, prio, tdict, steps = msg
-            self.last_versions[wid] = version
-            self.actor_steps += steps
-            # Fleet steps = chunk rows / actors-in-worker; tracked so a
-            # respawn only gets the worker's REMAINING actor.T budget.
-            n_w = self._worker_width(wid)
-            self._steps_by_worker[wid] = (
-                self._steps_by_worker.get(wid, 0) + steps // max(n_w, 1)
-            )
-            if kind == "dxp":
-                from ape_x_dqn_tpu.types import DedupChunk
+    def _decode_record(self, wid: int, payload: bytes) -> tuple:
+        """One ring record → (priorities, transitions) + pool accounting.
+        Arrays are zero-copy read-only views over the record's own buffer
+        (already out of the ring), handed straight to replay ingest."""
+        (kind, version, sent_t, steps, source, chunk_seq, prev_frames,
+         arrays) = decode_chunk(payload)
+        self.last_versions[wid] = version
+        self.actor_steps += steps
+        # Fleet steps = chunk rows / actors-in-worker; tracked so a
+        # respawn only gets the worker's REMAINING actor.T budget.
+        n_w = self._worker_width(wid)
+        self._steps_by_worker[wid] = (
+            self._steps_by_worker.get(wid, 0) + steps // max(n_w, 1)
+        )
+        self.transport.record_chunk(
+            len(payload), time.monotonic() - sent_t, steps
+        )
+        prio = arrays.pop("prio")
+        if kind == DXP:
+            from ape_x_dqn_tpu.types import DedupChunk
 
-                return (prio, DedupChunk(**tdict))
-            return (prio, self._NStepTransition(**tdict))
+            return (prio, DedupChunk(
+                source=source, chunk_seq=chunk_seq, prev_frames=prev_frames,
+                **arrays,
+            ))
+        return (prio, self._NStepTransition(**arrays))
+
+    def transport_stats(self) -> dict:
+        """Experience-transport metrics snapshot: ingest bytes/s, chunk
+        latency percentiles, ring-full backpressure events (live rings plus
+        retired incarnations), torn-record salvage counts."""
+        s = self.transport.summary()
+        s["ring_full_waits"] = self._full_waits_base + sum(
+            r.full_waits for r in self._rings.values()
+        )
+        s["rings"] = len(self._rings)
+        s["ring_bytes"] = self._ring_bytes
+        return s
+
+    def _dispatch(self, msg):
+        """Apply one control-channel message to pool state."""
+        kind = msg[0]
         if kind == "episodes":
             self.episodes.extend(msg[2])
         elif kind == "done":
@@ -607,7 +762,9 @@ class ProcessActorPool:
 
     def stop(self, join_timeout: float = 15.0):
         self.stop_event.set()
-        # Drain so no worker blocks on a full queue mid-put.
+        # Drain while joining: ring writers abort on the stop event by
+        # themselves (write() polls it), but the final control puts and any
+        # committed chunks should land in accounting before teardown.
         deadline = time.monotonic() + join_timeout
         for p in self._procs:
             while p.is_alive() and time.monotonic() < deadline:
@@ -616,6 +773,19 @@ class ProcessActorPool:
             if p.is_alive():
                 p.terminate()
                 p.join(timeout=5.0)
+        self.poll(max_items=256)  # last committed records + "done" messages
+        # Release every shm segment and control-queue fd on ALL exit paths
+        # (the 256-worker fd/shm budget depends on it).
+        for wid in list(self._rings):
+            ring = self._rings.pop(wid)
+            self._full_waits_base += ring.full_waits
+            ring.close()
+            ring.unlink()
+        for wid in list(self._queues):
+            try:
+                self._queues.pop(wid).close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         self.buffer.close()
 
 

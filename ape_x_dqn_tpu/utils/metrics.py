@@ -137,6 +137,61 @@ class LatencyHistogram:
         }
 
 
+class TransportStats:
+    """Experience-transport aggregator (process actors, runtime/shm_ring):
+    ingest bytes/s + chunk rates over a sliding window, chunk latency
+    (send→drain, log-bucketed percentiles), and the salvage counters the
+    SIGKILL discipline produces (fully-committed records recovered from a
+    dead incarnation's ring; torn tails detected).  Thread-safe where it
+    matters: the histograms/counters take their own locks, and the
+    cumulative ints are only written from the single drain thread.
+    """
+
+    def __init__(self, window_s: float = 30.0):
+        self.bytes_rate = RateCounter(window_s)
+        self.chunk_rate = RateCounter(window_s)
+        self.transition_rate = RateCounter(window_s)
+        self.latency = LatencyHistogram(min_s=1e-5, max_s=600.0)
+        self.chunks = 0
+        self.bytes = 0
+        self.transitions = 0
+        self.salvaged_records = 0
+        self.torn_records = 0
+
+    def record_chunk(self, nbytes: int, latency_s: float,
+                     transitions: int) -> None:
+        self.chunks += 1
+        self.bytes += nbytes
+        self.transitions += int(transitions)
+        self.bytes_rate.add(nbytes)
+        self.chunk_rate.add(1)
+        self.transition_rate.add(int(transitions))
+        # A negative send→drain delta can only be clock skew; clamp.
+        self.latency.record(max(0.0, latency_s))
+
+    def count_salvage(self, records: int, torn: bool) -> None:
+        self.salvaged_records += int(records)
+        if torn:
+            self.torn_records += 1
+
+    def summary(self) -> dict:
+        lat = self.latency.summary()
+        return {
+            "chunks": self.chunks,
+            "ingest_mb": round(self.bytes / 1e6, 2),
+            "transitions": self.transitions,
+            "ingest_mb_s": round(self.bytes_rate.rate() / 1e6, 2),
+            "chunks_s": round(self.chunk_rate.rate(), 1),
+            "transitions_s": round(self.transition_rate.rate(), 1),
+            "chunk_latency_ms": {
+                k: lat.get(k) for k in ("p50_ms", "p99_ms", "max_ms")
+                if k in lat
+            },
+            "salvaged_records": self.salvaged_records,
+            "torn_records": self.torn_records,
+        }
+
+
 class MetricLogger:
     """Aggregate scalars between emits; write one JSONL record per emit.
 

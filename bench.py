@@ -131,6 +131,32 @@ def _serving_bench(clients: int = 32, duration: float = 6.0,
     }
 
 
+def _xp_transport_bench(workers=(4, 16, 64), seconds: float = 3.0,
+                        rows: int = 64, obs_shape=(84, 84, 1),
+                        barrage_rounds: int = 2) -> dict:
+    """``xp_transport``: the actor→learner chunk path in isolation — shm
+    ring (runtime/shm_ring.py) vs the pre-ring pickle-over-mp.Queue — at
+    three fleet widths, plus the SIGKILL barrage proving zero
+    fully-committed chunks are lost across random mid-stream kills.
+
+    Host-only by construction (tools/xp_transport.py loads shm_ring.py by
+    file path; no process imports jax), so the section survives TPU-tunnel
+    outages alongside host_replay_2m / host_dedup_2m.
+    """
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.xp_transport import run_sigkill_barrage, run_transport_bench
+
+    out = run_transport_bench(list(workers), seconds=seconds, rows=rows,
+                              obs_shape=tuple(obs_shape))
+    out["sigkill_barrage"] = run_sigkill_barrage(
+        workers=min(4, max(workers)), rounds=barrage_rounds, rows=rows,
+        obs_shape=tuple(obs_shape),
+    )
+    for p in out["points"]:
+        p["shm_beats_queue_2x"] = bool(p["speedup"] >= 2.0)
+    return out
+
+
 def _make_chunks(rng, n, m, obs_shape, num_actions):
     import jax
     import jax.numpy as jnp
@@ -709,7 +735,29 @@ def main() -> None:
     parser.add_argument("--serving-network", default="conv",
                         choices=("conv", "nature", "mlp"))
     parser.add_argument("--serving-max-batch", type=int, default=32)
+    parser.add_argument("--skip-xp-transport", action="store_true",
+                        help="skip the shm-ring vs mp.Queue transport bench")
+    parser.add_argument("--xp-workers", default="4,16,64",
+                        help="comma-separated producer counts for "
+                        "xp_transport")
+    parser.add_argument("--xp-seconds", type=float, default=3.0)
+    parser.add_argument(
+        "--xp-transport-smoke", action="store_true",
+        help="CI gate: run ONLY a tiny xp_transport point + barrage "
+        "(host-only, no backend probe, seconds not minutes) and exit — "
+        "tools/verify_t1.sh uses this so an import-time regression in the "
+        "transport can't reach the driver unseen",
+    )
     args = parser.parse_args()
+
+    if args.xp_transport_smoke:
+        out = _xp_transport_bench(workers=(2,), seconds=0.5, rows=16,
+                                  obs_shape=(16, 16, 1), barrage_rounds=1)
+        bar = out["sigkill_barrage"]
+        assert bar["lost_committed_chunks"] == 0, bar
+        assert bar["seq_errors"] == 0, bar
+        print(json.dumps({"xp_transport_smoke": out}))
+        return
 
     extra: dict = {}
 
@@ -727,8 +775,21 @@ def main() -> None:
     probe = _probe_backend(args.probe_timeout)
     extra["backend_probe"] = probe
     outage = not probe["ok"]
+    # On-chip sections (fused headline, pipelines) need an accelerator: a
+    # probe that "succeeds" on a CPU-only backend (JAX_PLATFORMS=cpu, or a
+    # plugin falling back) must NOT send the conv-net fused scan to XLA-CPU
+    # — one 128-step fused call exceeds 9 minutes on a 1-core VM, so the
+    # driver's bench would burn hours producing meaningless numbers.  The
+    # host-only sections carry the line instead (same shape as an outage).
+    on_chip = not outage and probe.get("device_kind") != "cpu"
+    if not outage and not on_chip:
+        extra["on_chip_skipped"] = (
+            "backend is cpu-only (device_kind=cpu): fused/pipeline "
+            "sections are accelerator measurements and are skipped — "
+            "host-only sections committed instead"
+        )
 
-    if not outage:
+    if on_chip:
         import jax  # noqa: F401 — backend verified reachable
         import jax.numpy as jnp
 
@@ -767,7 +828,13 @@ def main() -> None:
                 duration=args.serving_duration,
                 network=args.serving_network,
                 max_batch=args.serving_max_batch)
-    if not outage and not args.skip_pipeline:
+    if not args.skip_xp_transport:
+        # Host-only (no jax in any producer/consumer): the actor→learner
+        # transport in isolation, shm ring vs mp.Queue, + SIGKILL barrage.
+        section("xp_transport", _xp_transport_bench,
+                workers=tuple(int(w) for w in args.xp_workers.split(",")),
+                seconds=args.xp_seconds)
+    if on_chip and not args.skip_pipeline:
         section("actor_solo", _actor_solo_bench)
         extra["pipeline"] = _median_pipeline(
             args.pipeline_trials, learner_steps=args.pipeline_steps
@@ -816,16 +883,39 @@ def main() -> None:
         # chip; one trial (time-bounded), compare `pipeline`'s median.
         section("pipeline_dedup", _pipeline_bench,
                 args.pipeline_steps, dedup=True)
-        p_thread = extra["pipeline"]["median_window_steps_per_sec"]
+        # process_vs_thread, settled (ROADMAP open item): a MATCHED pair —
+        # same 256 actors, same 32768 learner steps, same steps_per_call,
+        # median of the same number of trials — instead of comparing the
+        # historical sections' different shapes.  Thread-mode actors run
+        # jitted policy forwards on the learner's device; process-mode
+        # workers are truly CPU-only (jax_platforms=cpu pinned via
+        # jax.config in-child BEFORE any backend init — the round-5 fix;
+        # chunks ride the shm-ring transport).
+        extra["pipeline_thread_matched"] = _median_pipeline(
+            args.pipeline_trials,
+            learner_steps=32_768,
+            steps_per_call=2048,
+            num_actors=256,
+            min_replay=10_000,
+        )
+        p_thread = extra["pipeline_thread_matched"][
+            "median_window_steps_per_sec"]
         p_proc = extra["pipeline_process"]["median_window_steps_per_sec"]
         extra["process_vs_thread"] = {
             "thread_median": p_thread,
             "process_median": p_proc,
+            "winner": "process" if p_proc > p_thread else "thread",
             "process_beats_thread": bool(p_proc > p_thread),
+            "matched_config": {
+                "num_actors": 256, "learner_steps": 32_768,
+                "steps_per_call": 2048, "min_replay": 10_000,
+                "trials": args.pipeline_trials,
+            },
             "note": (
                 "medians of the steady-state window rate over "
-                f"{args.pipeline_trials} trials each, identical pinned "
-                "conditions per mode (see each section's config)"
+                f"{args.pipeline_trials} matched trials per mode "
+                "(pipeline_thread_matched vs pipeline_process); workers "
+                "are truly CPU-only in process mode"
             ),
         }
         extra["pipeline_process"]["note"] = (

@@ -29,3 +29,66 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# ---------------------------------------------------------------------------
+# Session-scoped transport-resource leak guard.
+#
+# The process-actor transport budget (256 workers × one shm ring + one
+# control-queue pipe pair each; config.transport_budget) is only
+# trustworthy if every exit path — clean stop, salvage-and-respawn,
+# SIGKILL barrage, bench teardown — releases its /dev/shm segments and
+# fds.  This fixture snapshots both at session start and asserts nothing
+# leaked by session end, so any new test that strands a segment or a pipe
+# fails the suite instead of silently eroding the fleet budget.
+# ---------------------------------------------------------------------------
+
+def _shm_segments():
+    try:
+        return set(os.listdir("/dev/shm"))
+    except OSError:  # no /dev/shm on this platform — guard is a no-op
+        return None
+
+
+def _pipe_fds():
+    """Count of pipe/FIFO fds held by THIS process (mp.Queue costs a pipe
+    pair; a leaked queue shows up here long before ulimit does)."""
+    import stat
+
+    n = 0
+    try:
+        for fd in os.listdir("/proc/self/fd"):
+            try:
+                if stat.S_ISFIFO(os.stat(f"/proc/self/fd/{fd}").st_mode):
+                    n += 1
+            except OSError:  # fd closed between listdir and stat
+                continue
+    except OSError:  # no /proc — guard is a no-op
+        return -1
+    return n
+
+
+@pytest.fixture(scope="session", autouse=True)
+def transport_leak_guard():
+    base_shm = _shm_segments()
+    base_pipes = _pipe_fds()
+    yield
+    import gc
+
+    gc.collect()  # drop test-local rings/queues awaiting finalizers
+    if base_shm is not None:
+        leaked = _shm_segments() - base_shm
+        assert not leaked, (
+            f"leaked /dev/shm segments after the suite: {sorted(leaked)} — "
+            "some exit path skipped ShmRing.unlink()/SharedParamBuffer "
+            "teardown"
+        )
+    if base_pipes >= 0:
+        now = _pipe_fds()
+        # Slack for lazily-created singletons (mp resource_tracker's pipe,
+        # logging handlers); a single leaked mp.Queue costs 2+ fds per
+        # worker so real leaks clear this bar immediately.
+        assert now <= base_pipes + 6, (
+            f"pipe-fd growth over the suite: {base_pipes} -> {now} — a "
+            "control queue was not closed on some pool exit path"
+        )

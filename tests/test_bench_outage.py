@@ -29,6 +29,8 @@ def test_bench_emits_host_only_json_during_outage():
         "--serving-duration", "1.0",
         "--serving-network", "mlp",
         "--serving-max-batch", "8",
+        "--xp-workers", "2",                # tiny: mechanism, not scale
+        "--xp-seconds", "0.5",
     ]
     proc = subprocess.run(
         cmd, capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
@@ -42,7 +44,8 @@ def test_bench_emits_host_only_json_during_outage():
     assert rec["backend_probe"]["ok"] is False
     assert rec["backend_probe"]["error"]
     # Host-only sections survive the outage...
-    for key in ("host_replay_2m", "host_dedup_2m", "serving_qps"):
+    for key in ("host_replay_2m", "host_dedup_2m", "serving_qps",
+                "xp_transport"):
         assert key in rec, f"missing host-only section {key}"
     assert rec["host_replay_2m"].get("sample_update_pairs_per_sec", 0) > 0
     # ...including the serving bench, which pins its child to CPU.

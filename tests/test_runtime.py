@@ -77,6 +77,23 @@ class TestPrefetchQueue:
             # depth 2 + at most one in-flight sample
             assert len(calls) <= 4
 
+    def test_timeout_is_wall_clock_from_call_entry(self):
+        """A sub-200 ms timeout must fire on time: the old get() only
+        started its deadline after the first queue.Empty and waited a flat
+        min(0.2, timeout) per retry, so timeouts overshot by up to a whole
+        retry period (and get(10.0) by ~0.2 s systematically)."""
+
+        def sample():
+            time.sleep(30.0)  # feeder never delivers
+            return 1
+
+        with PrefetchQueue(sample, place_fn=lambda x: x) as q:
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError, match="starved"):
+                q.get(timeout=0.15)
+            elapsed = time.monotonic() - t0
+        assert 0.15 <= elapsed < 0.6, elapsed
+
 
 class TestMetrics:
     def test_rate_counter(self):

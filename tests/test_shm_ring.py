@@ -1,0 +1,397 @@
+"""shm experience-ring tests: SPSC framing, wraparound, backpressure, the
+APXT wire-format identity, and the SIGKILL-mid-write salvage discipline
+(the shm analogue of round 5's mp.Queue deadlock finding)."""
+
+import os
+import signal
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.runtime.shm_ring import (
+    DXP,
+    XP,
+    ShmRing,
+    decode_chunk,
+    encode_chunk_parts,
+    pack_array_parts,
+    unpack_arrays,
+)
+
+
+def _ring_pair(capacity):
+    owner = ShmRing(capacity)
+    writer = ShmRing(capacity, name=owner.name, create=False)
+    return owner, writer
+
+
+class TestShmRing:
+    def test_roundtrip_and_order(self):
+        reader, writer = _ring_pair(1 << 12)
+        try:
+            assert reader.read_next() is None  # fresh ring: no phantom
+            for i in range(5):
+                assert writer.try_write([bytes([i]) * 100])
+            for i in range(5):
+                assert reader.read_next() == bytes([i]) * 100
+            assert reader.read_next() is None
+        finally:
+            writer.close()
+            reader.close()
+            reader.unlink()
+
+    def test_gathered_parts_concatenate(self):
+        reader, writer = _ring_pair(1 << 12)
+        try:
+            arr = np.arange(64, dtype=np.uint8)
+            assert writer.try_write([b"head", arr, b"tail"])
+            assert reader.read_next() == b"head" + arr.tobytes() + b"tail"
+        finally:
+            writer.close()
+            reader.close()
+            reader.unlink()
+
+    def test_wraparound_many_laps(self):
+        """Records byte-wrap across the ring end; content survives laps."""
+        reader, writer = _ring_pair(1000)  # deliberately unaligned
+        try:
+            for i in range(200):
+                payload = bytes([i % 251]) * (100 + i % 37)
+                assert writer.try_write([payload])
+                assert reader.read_next() == payload
+        finally:
+            writer.close()
+            reader.close()
+            reader.unlink()
+
+    def test_backpressure_and_release(self):
+        reader, writer = _ring_pair(2048)
+        try:
+            n = 0
+            while writer.try_write([b"x" * 400]):
+                n += 1
+            assert 1 <= n <= 5
+            assert not writer.try_write([b"x" * 400])
+            assert writer.write([b"x" * 400], timeout=0.05) is False
+            assert writer.full_waits > 0  # backpressure was counted
+            assert reader.read_next() is not None  # free one record
+            assert writer.try_write([b"x" * 400])
+        finally:
+            writer.close()
+            reader.close()
+            reader.unlink()
+
+    def test_oversized_record_raises(self):
+        reader, writer = _ring_pair(1 << 10)
+        try:
+            with pytest.raises(ValueError, match="xp_ring_bytes"):
+                writer.try_write([b"y" * 4096])
+        finally:
+            writer.close()
+            reader.close()
+            reader.unlink()
+
+    def test_torn_tail_detected_not_delivered(self):
+        """A writer that died between the intent mark and the commit word
+        (the SIGKILL-mid-record shape) leaves a tail the reader detects as
+        torn and never delivers — while every committed record salvages."""
+        reader, writer = _ring_pair(1 << 12)
+        try:
+            assert writer.try_write([b"committed-record"])
+            # Emulate the kill deterministically: intent mark + partial
+            # payload, no commit word (exactly the write() store order).
+            writer._set(32, writer.started + 1)          # w_started
+            writer._copy_in(writer._widx + 16, memoryview(b"half-writ"))
+            assert reader.read_next() == b"committed-record"
+            assert reader.read_next() is None
+            assert reader.torn_tail()
+            assert reader.records_read == 1
+        finally:
+            writer.close()
+            reader.close()
+            reader.unlink()
+
+    def test_stale_lap_bytes_never_alias(self):
+        """After the ring laps, old record headers sit at reusable offsets
+        — their seq words are from earlier indices and must never parse as
+        future records."""
+        reader, writer = _ring_pair(512)
+        try:
+            for i in range(40):  # many laps over the same bytes
+                assert writer.try_write([bytes([i]) * 64])
+                assert reader.read_next() == bytes([i]) * 64
+            assert reader.read_next() is None
+            assert not reader.torn_tail()
+        finally:
+            writer.close()
+            reader.close()
+            reader.unlink()
+
+
+class TestWireFormat:
+    def test_pack_matches_tree_to_bytes(self):
+        """The jax-free flat-dict serializer is byte-identical to
+        utils/serialization.tree_to_bytes — either end may use either."""
+        from ape_x_dqn_tpu.utils.serialization import (
+            tree_from_bytes,
+            tree_to_bytes,
+        )
+
+        rng = np.random.default_rng(3)
+        arrays = {
+            "obs": rng.integers(0, 255, (7, 5, 5, 1), dtype=np.uint8),
+            "action": rng.integers(0, 4, (7,)).astype(np.int32),
+            "prio": rng.random(7).astype(np.float32),
+            "zz_last": np.float32(1.5) * np.ones((), np.float32),
+        }
+        blob = b"".join(
+            bytes(memoryview(p).cast("B")) if not isinstance(p, bytes)
+            else p
+            for p in pack_array_parts(arrays)
+        )
+        assert blob == tree_to_bytes(arrays)
+        restored = tree_from_bytes(blob)
+        for k, v in arrays.items():
+            np.testing.assert_array_equal(np.asarray(restored[k]), v)
+
+    def test_unpack_views_are_zero_copy(self):
+        arrays = {"a": np.arange(12, dtype=np.int32).reshape(3, 4)}
+        blob = b"".join(
+            bytes(memoryview(p).cast("B")) if not isinstance(p, bytes)
+            else p
+            for p in pack_array_parts(arrays)
+        )
+        out = unpack_arrays(blob)
+        np.testing.assert_array_equal(out["a"], arrays["a"])
+        assert not out["a"].flags.writeable  # view over the payload bytes
+        assert out["a"].base is not None
+
+    def test_chunk_envelope_roundtrip(self):
+        arrays = {
+            "prio": np.ones(4, np.float32),
+            "frames": np.zeros((5, 2, 2, 1), np.uint8),
+        }
+        parts = encode_chunk_parts(DXP, 42, 4, arrays, source=3,
+                                   chunk_seq=17, prev_frames=9)
+        payload = b"".join(
+            bytes(memoryview(p).cast("B")) if not isinstance(p, bytes)
+            else p
+            for p in parts
+        )
+        kind, ver, sent_t, steps, src, cs, pf, back = decode_chunk(payload)
+        assert (kind, ver, steps, src, cs, pf) == (DXP, 42, 4, 3, 17, 9)
+        assert sent_t > 0
+        for k, v in arrays.items():
+            np.testing.assert_array_equal(back[k], v)
+
+    def test_xp_kind_roundtrip_through_ring(self):
+        reader, writer = _ring_pair(1 << 16)
+        try:
+            arrays = {
+                "prio": np.full(3, 0.5, np.float32),
+                "obs": np.ones((3, 4, 4, 1), np.uint8),
+            }
+            assert writer.try_write(encode_chunk_parts(XP, 1, 3, arrays))
+            kind, ver, _, steps, _, _, _, back = decode_chunk(
+                reader.read_next()
+            )
+            assert (kind, ver, steps) == (XP, 1, 3)
+            np.testing.assert_array_equal(back["obs"], arrays["obs"])
+        finally:
+            writer.close()
+            reader.close()
+            reader.unlink()
+
+
+class TestSigkillMidWrite:
+    def test_sigkill_barrage_salvages_all_committed(self):
+        """The adversarial kill test: real producer processes SIGKILLed at
+        random moments mid-stream.  Every fully-committed record must be
+        salvaged in order; a kill that landed mid-record must surface as a
+        torn tail, never as delivered garbage.  (Producers are numpy-only
+        — tools/xp_transport loads shm_ring.py by file path — so this
+        spawns fast despite being a real-process test.)"""
+        from tools.xp_transport import run_sigkill_barrage
+
+        out = run_sigkill_barrage(workers=3, rounds=3, rows=32,
+                                  obs_shape=(16, 16, 1), ring_bytes=1 << 18)
+        assert out["producers_killed"] == 9
+        assert out["committed_chunks"] > 0
+        assert out["lost_committed_chunks"] == 0, out
+        assert out["seq_errors"] == 0, out
+        assert out["salvaged_chunks"] >= out["committed_chunks"]
+
+    def test_pool_salvage_gives_respawn_fresh_ring(self):
+        """Pool-level discipline without real jax workers: a dead
+        incarnation's committed records salvage into poll(), the torn tail
+        is counted, and the respawned incarnation's ring is a NEW segment
+        (its stream restarts seq-clean)."""
+        from ape_x_dqn_tpu.config import ApexConfig
+        from ape_x_dqn_tpu.runtime.process_actors import ProcessActorPool
+
+        cfg = ApexConfig()
+        cfg.network = "mlp"
+        cfg.env.name = "chain:6"
+        cfg.actor.mode = "process"
+        cfg.actor.num_workers = 1
+        cfg.actor.num_actors = 2
+        cfg.validate()
+        pool = ProcessActorPool(cfg, num_workers=1, ring_bytes=1 << 16)
+        try:
+            # Stand in for a worker incarnation: write two committed
+            # chunks + one torn tail directly into wid 0's ring.
+            pool._queues[0] = pool._ctx.Queue(maxsize=4)
+            pool._rings[0] = ShmRing(1 << 16)
+            old_name = pool._rings[0].name
+            w = ShmRing(1 << 16, name=old_name, create=False)
+            arrays = {"prio": np.ones(2, np.float32),
+                      "obs": np.zeros((2, 3), np.uint8),
+                      "action": np.zeros(2, np.int32),
+                      "reward": np.zeros(2, np.float32),
+                      "discount": np.ones(2, np.float32),
+                      "next_obs": np.zeros((2, 3), np.uint8)}
+            assert w.try_write(encode_chunk_parts(XP, 5, 2, arrays))
+            assert w.try_write(encode_chunk_parts(XP, 6, 2, arrays))
+            w._set(32, w.started + 1)  # torn tail: intent, no commit
+            w.close()
+            pool._salvage_incarnation(0)
+            assert len(pool._salvaged) == 2
+            stats = pool.transport_stats()
+            assert stats["salvaged_records"] == 2
+            assert stats["torn_records"] == 1
+            # poll() delivers the salvage; accounting advanced.
+            items = pool.poll(max_items=8)
+            assert len(items) == 2
+            assert pool.last_versions[0] == 6
+            assert 0 not in pool._rings  # retired; _spawn would make fresh
+        finally:
+            pool.stop(join_timeout=1.0)
+
+
+class TestPoolRingSweep:
+    def test_poll_round_robins_rings_with_budget(self):
+        """The batched sweep drains multiple rings fairly and respects the
+        byte drain budget."""
+        from ape_x_dqn_tpu.config import ApexConfig
+        from ape_x_dqn_tpu.runtime.process_actors import ProcessActorPool
+
+        cfg = ApexConfig()
+        cfg.network = "mlp"
+        cfg.env.name = "chain:6"
+        cfg.actor.mode = "process"
+        cfg.actor.num_workers = 2
+        cfg.actor.num_actors = 2
+        cfg.validate()
+        pool = ProcessActorPool(cfg, num_workers=2, ring_bytes=1 << 16)
+        writers = []
+        try:
+            arrays = {"prio": np.ones(1, np.float32),
+                      "obs": np.zeros((1, 3), np.uint8),
+                      "action": np.zeros(1, np.int32),
+                      "reward": np.zeros(1, np.float32),
+                      "discount": np.ones(1, np.float32),
+                      "next_obs": np.zeros((1, 3), np.uint8)}
+            for wid in range(2):
+                pool._queues[wid] = pool._ctx.Queue(maxsize=4)
+                pool._rings[wid] = ShmRing(1 << 16)
+                w = ShmRing(1 << 16, name=pool._rings[wid].name,
+                            create=False)
+                writers.append(w)
+                for _ in range(6):
+                    assert w.try_write(
+                        encode_chunk_parts(XP, wid + 1, 1, arrays)
+                    )
+            # Both rings contribute even with a tiny per-poll item cap.
+            items = pool.poll(max_items=8)
+            assert len(items) == 8
+            assert set(pool.last_versions) == {0, 1}
+            # Byte budget bounds one sweep; the remainder arrives next poll.
+            rest = pool.poll(max_items=64, max_bytes=1)
+            assert len(rest) >= 1  # budget admits at least one record
+            total = len(items) + len(rest) + len(pool.poll(max_items=64))
+            assert total == 12
+        finally:
+            for w in writers:
+                w.close()
+            pool.stop(join_timeout=1.0)
+
+
+class TestDedupWire:
+    def test_pool_decodes_dxp_record_to_dedup_chunk(self):
+        """The dedup wire through the transport: a DXP record shaped
+        exactly like _worker_main's encode (arrays as APXT buffers, the
+        int identity fields on the envelope) decodes back to a faithful
+        DedupChunk in poll()."""
+        from ape_x_dqn_tpu.config import ApexConfig
+        from ape_x_dqn_tpu.runtime.process_actors import ProcessActorPool
+        from ape_x_dqn_tpu.types import DedupChunk
+
+        cfg = ApexConfig()
+        cfg.network = "mlp"
+        cfg.env.name = "chain:6"
+        cfg.actor.mode = "process"
+        cfg.actor.num_workers = 1
+        cfg.actor.num_actors = 2
+        cfg.validate()
+        rng = np.random.default_rng(7)
+        chunk = DedupChunk(
+            frames=rng.integers(0, 255, (5, 4, 4, 1), dtype=np.uint8),
+            obs_ref=np.array([-2, 0, 1], np.int32),
+            next_ref=np.array([2, 3, 4], np.int32),
+            action=np.array([0, 1, 2], np.int32),
+            reward=rng.normal(size=3).astype(np.float32),
+            discount=np.full(3, 0.97, np.float32),
+            source=11, chunk_seq=4, prev_frames=6,
+        )
+        prio = np.array([0.5, 1.0, 2.0], np.float32)
+        d = chunk._asdict()
+        parts = encode_chunk_parts(
+            DXP, 9, 3,
+            {"prio": prio,
+             **{k: np.asarray(d[k])
+                for k in ("frames", "obs_ref", "next_ref", "action",
+                          "reward", "discount")}},
+            source=d["source"], chunk_seq=d["chunk_seq"],
+            prev_frames=d["prev_frames"],
+        )
+        pool = ProcessActorPool(cfg, num_workers=1, ring_bytes=1 << 16)
+        try:
+            pool._queues[0] = pool._ctx.Queue(maxsize=4)
+            pool._rings[0] = ShmRing(1 << 16)
+            w = ShmRing(1 << 16, name=pool._rings[0].name, create=False)
+            assert w.try_write(parts)
+            w.close()
+            items = pool.poll(max_items=4)
+            assert len(items) == 1
+            got_prio, got = items[0]
+            np.testing.assert_array_equal(got_prio, prio)
+            assert isinstance(got, DedupChunk)
+            assert (got.source, got.chunk_seq, got.prev_frames) == (11, 4, 6)
+            for f in ("frames", "obs_ref", "next_ref", "action", "reward",
+                      "discount"):
+                np.testing.assert_array_equal(getattr(got, f), d[f])
+            assert pool.last_versions[0] == 9
+        finally:
+            pool.stop(join_timeout=1.0)
+
+
+class TestTransportBudget:
+    def test_transport_budget_arithmetic(self):
+        from ape_x_dqn_tpu.config import ApexConfig, transport_budget
+
+        cfg = ApexConfig()
+        cfg.actor.xp_ring_bytes = 1 << 20
+        b = transport_budget(cfg, num_workers=256)
+        assert b["workers"] == 256
+        assert b["shm_segments"] == 257
+        assert b["ring_bytes_total"] == 256 << 20
+
+    def test_ring_knob_validation(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+
+        cfg = ApexConfig()
+        cfg.actor.xp_ring_bytes = 1024
+        with pytest.raises(ValueError, match="xp_ring_bytes"):
+            cfg.validate()

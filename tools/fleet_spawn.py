@@ -1,0 +1,144 @@
+"""Throttled process-actor fleet spawn on the shm transport — config3's
+fleet shape (256 workers, 16x16), scaled to whatever VM runs this.
+
+The ROADMAP open item "spawn config3's fleet shape for real" needs three
+things proven at fleet width: (1) the fd/shm budget holds (one experience
+ring + one control queue per worker, one param seqlock buffer for all),
+(2) a throttled spawn brings the whole fleet up without piling every
+child's jax import onto the host at once, and (3) a SIGKILL of a worker
+subset recovers fully — salvage of every committed chunk, fresh rings for
+the respawned incarnations, experience flowing again from every killed
+worker id.  This tool runs exactly that and prints one JSON line.
+
+Usage (the committed demo artifact's producer):
+
+    python tools/fleet_spawn.py --workers 64 --kill 8 --stagger 0.1 \
+        --out demos/fleet_spawn.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workers", type=int, default=64)
+    ap.add_argument("--actors", type=int, default=0,
+                    help="global actor count (default: one per worker)")
+    ap.add_argument("--kill", type=int, default=8,
+                    help="workers to SIGKILL once the fleet is flowing")
+    ap.add_argument("--stagger", type=float, default=0.1,
+                    help="seconds between worker spawns (throttle)")
+    ap.add_argument("--ring-mb", type=float, default=1.0,
+                    help="per-worker experience ring size (MB)")
+    ap.add_argument("--env", default="chain:6")
+    ap.add_argument("--network", default="mlp")
+    ap.add_argument("--flow-timeout", type=float, default=1800.0,
+                    help="deadline for every worker's first chunk")
+    ap.add_argument("--out", default="-")
+    args = ap.parse_args()
+
+    # CPU-only end to end: the fleet tool must not touch (or hang on) a
+    # TPU tunnel — same bootstrap as the tests/bench children.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ape_x_dqn_tpu.config import ApexConfig, transport_budget
+    from ape_x_dqn_tpu.runtime.process_actors import (
+        ProcessActorPool,
+        network_and_template,
+    )
+
+    cfg = ApexConfig()
+    cfg.network = args.network
+    cfg.env.name = args.env
+    cfg.actor.mode = "process"
+    cfg.actor.num_workers = args.workers
+    cfg.actor.num_actors = args.actors or args.workers
+    cfg.actor.T = 1_000_000_000
+    cfg.actor.flush_every = 8
+    cfg.actor.sync_every = 64
+    cfg.actor.worker_nice = 10
+    cfg.actor.xp_ring_bytes = int(args.ring_mb * (1 << 20))
+    cfg.actor.spawn_stagger_s = args.stagger
+    cfg.validate()
+
+    report: dict = {
+        "workers": args.workers,
+        "actors": cfg.actor.num_actors,
+        "stagger_s": args.stagger,
+        "planned_budget": transport_budget(cfg),
+    }
+    pool = ProcessActorPool(cfg, num_workers=args.workers,
+                            max_restarts=args.kill + 2)
+    try:
+        _, _, template = network_and_template(cfg)
+        pool.publish(template)
+        t0 = time.monotonic()
+        pool.start()
+        report["spawn_s"] = round(time.monotonic() - t0, 2)
+        report["accounting_after_spawn"] = pool.shm_accounting()
+
+        def drain_until(cond, timeout_s, label):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                pool.supervise()
+                pool.poll(max_items=512, timeout=0.05)
+                if cond():
+                    return
+                if pool.worker_errors:
+                    raise RuntimeError(
+                        f"fatal worker errors during {label}: "
+                        f"{pool.worker_errors}"
+                    )
+            raise TimeoutError(f"{label} did not complete in {timeout_s}s")
+
+        all_wids = set(range(args.workers))
+        drain_until(lambda: set(pool.last_versions) == all_wids,
+                    args.flow_timeout, "first-chunk-from-every-worker")
+        report["all_flowing_s"] = round(time.monotonic() - t0, 2)
+
+        victims = sorted(all_wids)[:args.kill]
+        steps_before = {w: pool._steps_by_worker.get(w, 0) for w in victims}
+        for w in victims:
+            os.kill(pool._procs[w].pid, signal.SIGKILL)
+        for w in victims:
+            pool._procs[w].join(15.0)
+        t_kill = time.monotonic()
+        drain_until(
+            lambda: all(pool._steps_by_worker.get(w, 0) > steps_before[w]
+                        for w in victims),
+            args.flow_timeout, "recovery-after-kill",
+        )
+        report["killed"] = len(victims)
+        report["recovery_s"] = round(time.monotonic() - t_kill, 2)
+        report["restarts"] = pool.restarts
+        report["recovered"] = True
+        report["accounting_after_recovery"] = pool.shm_accounting()
+        report["transport"] = pool.transport_stats()
+    finally:
+        pool.stop(join_timeout=60.0)
+    report["accounting_after_stop"] = pool.shm_accounting()
+    report["total_actor_steps"] = pool.actor_steps
+    line = json.dumps(report)
+    if args.out == "-":
+        print(line)
+    else:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+        print(line)
+
+
+if __name__ == "__main__":
+    main()

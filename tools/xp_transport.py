@@ -1,0 +1,372 @@
+"""Experience-transport microbench: shm ring vs pickle-over-mp.Queue.
+
+Measures the actor→learner chunk path in isolation — N producer processes
+pushing realistic experience chunks at one consumer — for both transports:
+
+  * ``mp_queue``: the pre-ring production path verbatim (one bounded
+    ``mp.Queue`` per worker, chunks as pickled numpy dicts).
+  * ``shm_ring``: one ``runtime/shm_ring.ShmRing`` per worker, chunks in
+    the APXT wire format gathered straight into shared memory.
+
+Also runs the SIGKILL barrage: ring producers killed at random moments
+mid-stream, then a full salvage — proving zero fully-committed chunks are
+lost and torn tails are detected (the property the transport exists for).
+
+This module is deliberately import-light (stdlib + numpy): producer
+children and the bench driver load ``shm_ring.py`` BY FILE PATH instead of
+through the package, so no child ever pays the package's jax import — the
+section is host-only and survives TPU-tunnel outages alongside
+host_replay_2m / host_dedup_2m (bench.py's outage discipline).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import queue as queue_mod
+import signal
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+_SHM_RING_PATH = os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir,
+    "ape_x_dqn_tpu", "runtime", "shm_ring.py",
+))
+
+
+def load_shm_ring():
+    """shm_ring as a standalone module (no package import, no jax)."""
+    spec = importlib.util.spec_from_file_location("_apex_shm_ring",
+                                                  _SHM_RING_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _make_arrays(wid: int, rows: int, obs_shape) -> Dict[str, np.ndarray]:
+    """One dense experience chunk's arrays, production-shaped (the xp wire
+    dict: priorities + the five NStepTransition fields)."""
+    rng = np.random.default_rng(wid)
+    return {
+        "prio": (np.abs(rng.normal(size=rows)) + 0.1).astype(np.float32),
+        "obs": rng.integers(0, 255, (rows, *obs_shape), dtype=np.uint8),
+        "action": rng.integers(0, 4, (rows,), dtype=np.int32),
+        "reward": rng.normal(size=(rows,)).astype(np.float32),
+        "discount": np.full((rows,), 0.97, np.float32),
+        "next_obs": rng.integers(0, 255, (rows, *obs_shape), dtype=np.uint8),
+    }
+
+
+def _nice(n: int) -> None:
+    """Production parity: worker processes run niced so the learner-side
+    drain thread stays scheduled (config.ActorConfig.worker_nice) —
+    applied identically to BOTH transports' producers."""
+    try:
+        os.nice(n)
+    except OSError:
+        pass
+
+
+def _queue_producer(q, wid: int, rows: int, obs_shape, stop_evt,
+                    nice: int = 10) -> None:
+    """The pre-ring production put, verbatim shape: pickle through a
+    bounded mp.Queue."""
+    _nice(nice)
+    arrays = _make_arrays(wid, rows, obs_shape)
+    prio = arrays["prio"]
+    tdict = {k: v for k, v in arrays.items() if k != "prio"}
+    seq = 0
+    while not stop_evt.is_set():
+        try:
+            q.put(("xp", wid, seq, prio, tdict, rows), timeout=0.1)
+            seq += 1
+        except queue_mod.Full:
+            continue
+
+
+def _ring_producer(ring_name: str, capacity: int, wid: int, rows: int,
+                   obs_shape, stop_evt, nice: int = 10) -> None:
+    """Chunks into the shm ring, the production encode path (version field
+    carries the chunk seq so the barrage can validate per-chunk identity)."""
+    _nice(nice)
+    mod = load_shm_ring()
+    ring = mod.ShmRing(capacity, name=ring_name, create=False)
+    arrays = _make_arrays(wid, rows, obs_shape)
+    seq = 0
+    try:
+        while not stop_evt.is_set():
+            parts = mod.encode_chunk_parts(mod.XP, seq, rows, arrays)
+            if not ring.write(parts, should_stop=stop_evt.is_set):
+                break
+            seq += 1
+    finally:
+        ring.close()
+
+
+def _spawn_all(ctx, target, argss):
+    procs = []
+    for args in argss:
+        p = ctx.Process(target=target, args=args, daemon=True)
+        p.start()
+        procs.append(p)
+    return procs
+
+
+def run_transport_point(transport: str, workers: int, seconds: float,
+                        rows: int = 64, obs_shape=(84, 84, 1),
+                        ring_bytes: int = 4 << 20,
+                        ready_timeout: float = 180.0) -> dict:
+    """One load point: ``workers`` producers → one consumer for a timed
+    window.  The window starts only after EVERY producer has delivered at
+    least one chunk (spawn/startup cost excluded — both transports pay
+    identical numpy-only child imports)."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    stop_evt = ctx.Event()
+    mod = load_shm_ring()
+    rings: List = []
+    queues: List = []
+    if transport == "shm_ring":
+        rings = [mod.ShmRing(ring_bytes) for _ in range(workers)]
+        procs = _spawn_all(ctx, _ring_producer, [
+            (r.name, ring_bytes, w, rows, obs_shape, stop_evt)
+            for w, r in enumerate(rings)
+        ])
+    elif transport == "mp_queue":
+        queues = [ctx.Queue(maxsize=8) for _ in range(workers)]
+        procs = _spawn_all(ctx, _queue_producer, [
+            (q, w, rows, obs_shape, stop_evt) for w, q in enumerate(queues)
+        ])
+    else:
+        raise ValueError(f"unknown transport {transport}")
+
+    rr = [0]  # rotating scan start: a first-match scan from index 0 would
+    # never poll later channels while channel 0 has data (with N producers
+    # refilling faster than one consumer drains, that is ALWAYS) — the
+    # ready phase would livelock waiting for every producer's first chunk.
+
+    def consume_once() -> Optional[tuple]:
+        """(wid, nbytes, rows) of one chunk, or None if nothing ready."""
+        for i in range(workers):
+            w = (rr[0] + i) % workers
+            if transport == "shm_ring":
+                rec = rings[w].read_next()
+                if rec is None:
+                    continue
+                rr[0] = (w + 1) % workers
+                return (w, len(rec), rows)
+            try:
+                msg = queues[w].get_nowait()
+            except queue_mod.Empty:
+                continue
+            rr[0] = (w + 1) % workers
+            # Production-shaped cost: touch the arrays the way the pool
+            # decode does (pickle already materialized them).
+            _, wid, _, prio, tdict, n = msg
+            return (wid, prio.nbytes + sum(v.nbytes
+                                           for v in tdict.values()), n)
+        return None
+
+    try:
+        seen = set()
+        deadline = time.monotonic() + ready_timeout
+        while len(seen) < workers:
+            got = consume_once()
+            if got is not None:
+                seen.add(got[0])
+            elif time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{transport}: only {len(seen)}/{workers} producers "
+                    "delivered within the ready timeout"
+                )
+            else:
+                time.sleep(0.0005)
+        t0 = time.monotonic()
+        chunks = rows_n = nbytes = 0
+        while time.monotonic() - t0 < seconds:
+            got = consume_once()
+            if got is None:
+                time.sleep(0.0002)
+                continue
+            chunks += 1
+            nbytes += got[1]
+            rows_n += got[2]
+        elapsed = time.monotonic() - t0
+    finally:
+        stop_evt.set()
+        for q in queues:  # unblock producers stuck in a full put
+            try:
+                while True:
+                    q.get_nowait()
+            except Exception:  # noqa: BLE001 — teardown drain
+                pass
+        for p in procs:
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5.0)
+        for q in queues:
+            q.close()
+        for r in rings:
+            r.close()
+            r.unlink()
+    return {
+        "transport": transport,
+        "workers": workers,
+        "transitions_per_sec": round(rows_n / elapsed, 1),
+        "chunks_per_sec": round(chunks / elapsed, 1),
+        "mb_per_sec": round(nbytes / elapsed / 1e6, 2),
+        "chunk_transitions": rows,
+        "window_s": round(elapsed, 2),
+    }
+
+
+def run_transport_bench(workers_list: Sequence[int] = (4, 16, 64),
+                        seconds: float = 3.0, rows: int = 64,
+                        obs_shape=(84, 84, 1),
+                        ring_bytes: int = 4 << 20) -> dict:
+    points = []
+    for w in workers_list:
+        mpq = run_transport_point("mp_queue", w, seconds, rows, obs_shape)
+        shm = run_transport_point("shm_ring", w, seconds, rows, obs_shape,
+                                  ring_bytes=ring_bytes)
+        base = max(mpq["transitions_per_sec"], 1e-9)
+        points.append({
+            "workers": w,
+            "mp_queue": mpq,
+            "shm_ring": shm,
+            "speedup": round(shm["transitions_per_sec"] / base, 2),
+        })
+    return {
+        "points": points,
+        "chunk_transitions": rows,
+        "obs_shape": list(obs_shape),
+        "note": (
+            "N producer processes -> 1 consumer, per-worker channels both "
+            "ways; timed window starts after every producer's first chunk "
+            "(startup excluded); host-only (no jax in any process)"
+        ),
+    }
+
+
+def run_sigkill_barrage(workers: int = 4, rounds: int = 2, rows: int = 64,
+                        obs_shape=(84, 84, 1),
+                        ring_bytes: int = 1 << 20) -> dict:
+    """Kill ring producers at random moments mid-stream, then salvage.
+
+    Asserts the transport's core safety property, per ring per round:
+    every chunk the producer committed is drained intact and in order
+    (``lost_committed == 0``), and a kill that landed mid-record is
+    detected as a torn tail rather than corrupting the stream.
+    """
+    import multiprocessing as mp
+
+    mod = load_shm_ring()
+    ctx = mp.get_context("spawn")
+    rng = np.random.default_rng(0)
+    killed = committed_total = consumed_total = lost = torn = 0
+    seq_errors = 0
+    for _ in range(rounds):
+        stop_evt = ctx.Event()
+        rings = [mod.ShmRing(ring_bytes) for _ in range(workers)]
+        procs = _spawn_all(ctx, _ring_producer, [
+            (r.name, ring_bytes, w, rows, obs_shape, stop_evt)
+            for w, r in enumerate(rings)
+        ])
+        try:
+            consumed = [0] * workers
+            next_seq = [0] * workers
+
+            def drain_all():
+                nonlocal seq_errors
+                for w, r in enumerate(rings):
+                    while True:
+                        rec = r.read_next()
+                        if rec is None:
+                            break
+                        # version field carries the producer's chunk seq —
+                        # must arrive contiguous from 0.
+                        _, version, *_ = mod.decode_chunk(rec)
+                        if version != next_seq[w]:
+                            seq_errors += 1
+                        next_seq[w] += 1
+                        consumed[w] += 1
+
+            # Let every producer commit at least one record (kills during
+            # the child's numpy-import window prove nothing).
+            deadline = time.monotonic() + 180.0
+            while any(r.committed == 0 for r in rings):
+                drain_all()
+                if time.monotonic() > deadline:
+                    raise TimeoutError("barrage producers never delivered")
+                time.sleep(0.001)
+            # Staggered random kills while the consumer keeps draining, so
+            # writers are actively copying (not parked in backpressure)
+            # when the SIGKILL lands.
+            order = rng.permutation(workers)
+            for w in order:
+                t_kill = time.monotonic() + float(rng.uniform(0.01, 0.15))
+                while time.monotonic() < t_kill:
+                    drain_all()
+                os.kill(procs[w].pid, signal.SIGKILL)
+                killed += 1
+            for p in procs:
+                p.join(timeout=10.0)
+            drain_all()  # full salvage of the dead incarnations
+            for w, r in enumerate(rings):
+                committed_total += r.committed
+                consumed_total += consumed[w]
+                lost += max(0, r.committed - consumed[w])
+                if r.torn_tail():
+                    torn += 1
+        finally:
+            stop_evt.set()
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+                    p.join(timeout=5.0)
+            for r in rings:
+                r.close()
+                r.unlink()
+    return {
+        "producers_killed": killed,
+        "committed_chunks": committed_total,
+        "salvaged_chunks": consumed_total,
+        "lost_committed_chunks": lost,
+        "seq_errors": seq_errors,
+        "torn_tails_detected": torn,
+        "note": (
+            "SIGKILL at random moments mid-stream; salvage must recover "
+            "every fully-committed chunk in order (consumed may exceed the "
+            "committed counter by <=1/ring: a kill can land between the "
+            "record's commit word and the counter update)"
+        ),
+    }
+
+
+if __name__ == "__main__":
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workers", default="4,16,64")
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--rows", type=int, default=64)
+    ap.add_argument("--obs", default="84x84x1")
+    ap.add_argument("--skip-barrage", action="store_true")
+    args = ap.parse_args()
+    obs = tuple(int(x) for x in args.obs.split("x"))
+    out = {
+        "bench": run_transport_bench(
+            [int(w) for w in args.workers.split(",")],
+            seconds=args.seconds, rows=args.rows, obs_shape=obs,
+        ),
+    }
+    if not args.skip_barrage:
+        out["sigkill_barrage"] = run_sigkill_barrage(
+            rows=args.rows, obs_shape=obs,
+        )
+    print(json.dumps(out))
