@@ -374,4 +374,115 @@ int32_t rc_import(void* h, int64_t nf, const uint8_t* frames, int64_t size,
   return 0;
 }
 
+// ---- incremental snapshot (dirty spans + sparse; utils/checkpoint_inc) --
+// The rings write sequentially at cursors, so a delta is the frame span +
+// transition span written since the last snapshot plus the sparse slots
+// whose priority/liveness changed.  These exports/imports are the C-core
+// halves of NativeDedupReplay.delta_state_dict / apply_delta_state_dict;
+// row order matches the python twin's fancy-indexed spans exactly.
+
+// Full liveness vector [capacity] — the wrapper diffs it against the
+// previous snapshot's copy to find sweep-invalidated slots (the sweep
+// runs inside rc_add, so python never sees the indices directly).
+void rc_export_alive(void* h, uint8_t* out) {
+  Core* c = static_cast<Core*>(h);
+  std::memcpy(out, c->alive.data(), static_cast<size_t>(c->capacity));
+}
+
+// Wrap-aware copy of n frame slots starting at seq fstart (n <= Cf).
+void rc_export_frames_span(void* h, int64_t fstart, int64_t n,
+                           uint8_t* out) {
+  Core* c = static_cast<Core*>(h);
+  int64_t slot = fstart % c->frame_capacity;
+  int64_t first = std::min(n, c->frame_capacity - slot);
+  std::memcpy(out, c->frames + slot * c->frame_bytes,
+              static_cast<size_t>(first) * c->frame_bytes);
+  if (first < n)
+    std::memcpy(out + first * c->frame_bytes, c->frames,
+                static_cast<size_t>(n - first) * c->frame_bytes);
+}
+
+void rc_import_frames_span(void* h, int64_t fstart, int64_t n,
+                           const uint8_t* frames) {
+  Core* c = static_cast<Core*>(h);
+  int64_t slot = fstart % c->frame_capacity;
+  int64_t first = std::min(n, c->frame_capacity - slot);
+  std::memcpy(c->frames + slot * c->frame_bytes, frames,
+              static_cast<size_t>(first) * c->frame_bytes);
+  if (first < n)
+    std::memcpy(c->frames, frames + first * c->frame_bytes,
+                static_cast<size_t>(n - first) * c->frame_bytes);
+}
+
+// n transition rows from ring slot `start` (wrap-aware), with liveness
+// and tree mass — the full dirty span of one delta.
+void rc_export_rows(void* h, int64_t start, int64_t n, int64_t* obs_seq,
+                    int64_t* next_seq, int32_t* action, float* reward,
+                    float* discount, uint8_t* alive, double* mass) {
+  Core* c = static_cast<Core*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = (start + i) % c->capacity;
+    obs_seq[i] = c->obs_seq[slot];
+    next_seq[i] = c->next_seq[slot];
+    action[i] = c->action[slot];
+    reward[i] = c->reward[slot];
+    discount[i] = c->discount[slot];
+    alive[i] = c->alive[slot];
+    Stripe& s = c->stripes[stripe_of(*c, slot)];
+    mass[i] = s.tree[s.leaf_base + leaf_of(*c, slot)];
+  }
+}
+
+void rc_import_rows(void* h, int64_t start, int64_t n,
+                    const int64_t* obs_seq, const int64_t* next_seq,
+                    const int32_t* action, const float* reward,
+                    const float* discount, const uint8_t* alive,
+                    const double* mass) {
+  Core* c = static_cast<Core*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = (start + i) % c->capacity;
+    c->obs_seq[slot] = obs_seq[i];
+    c->next_seq[slot] = next_seq[i];
+    c->action[slot] = action[i];
+    c->reward[slot] = reward[i];
+    c->discount[slot] = discount[i];
+    c->alive[slot] = alive[i];
+    Stripe& s = c->stripes[stripe_of(*c, slot)];
+    tree_set_one(s, leaf_of(*c, slot), mass[i]);
+  }
+}
+
+void rc_export_mass(void* h, int64_t n, const int64_t* idx, double* out) {
+  Core* c = static_cast<Core*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = idx[i];
+    if (slot < 0 || slot >= c->capacity) { out[i] = 0.0; continue; }
+    Stripe& s = c->stripes[stripe_of(*c, slot)];
+    out[i] = s.tree[s.leaf_base + leaf_of(*c, slot)];
+  }
+}
+
+// Restore-side sparse apply: exact (alive, mass) values captured at
+// snapshot time (no liveness re-derivation — bit-for-bit restores).
+void rc_apply_sparse(void* h, int64_t n, const int64_t* idx,
+                     const uint8_t* alive, const double* mass) {
+  Core* c = static_cast<Core*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = idx[i];
+    if (slot < 0 || slot >= c->capacity) continue;
+    c->alive[slot] = alive[i];
+    Stripe& s = c->stripes[stripe_of(*c, slot)];
+    tree_set_one(s, leaf_of(*c, slot), mass[i]);
+  }
+}
+
+void rc_set_counters(void* h, int64_t cursor, int64_t count,
+                     int64_t fcount, int64_t frame_dead) {
+  Core* c = static_cast<Core*>(h);
+  c->cursor = cursor % c->capacity;
+  c->count = count;
+  c->fcount = fcount;
+  c->frame_dead = frame_dead;
+}
+
 }  // extern "C"

@@ -115,6 +115,19 @@ class LearnerConfig:
     # pull-rarely mismatch this cap fixes (SURVEY §2 backend entry).
     checkpoint_every: int = 0             # steps; 0 disables
     checkpoint_dir: str = "checkpoints"
+    # Incremental async replay checkpointing (utils/checkpoint_inc): the
+    # replay leg leaves save_checkpoint's inline np.savez (minutes of
+    # learner dead air at a 17.6 GB dedup ring) for dirty-span delta
+    # chunks written by a dedicated writer thread — the learner only
+    # snapshots cursors + the span written since the last save.  The
+    # train-state leg stays on orbax either way.
+    checkpoint_incremental: bool = False
+    # Deltas per generation before a full base snapshot bounds the chain
+    # (restore replays base + up to this many deltas).
+    checkpoint_base_every: int = 16
+    # zlib-compress chunk payloads (writer-thread CPU for ~2-4x smaller
+    # chunks; the learner-visible stall is unchanged either way).
+    checkpoint_compress: bool = False
     # Device-resident fused path (replay/device.py): replay lives in HBM and
     # each dispatch runs steps_per_call sample/train/restamp steps — the
     # throughput mode; False = host replay + per-step train (golden path).
@@ -232,6 +245,8 @@ class ApexConfig:
             (a.mode != "process" or a.num_actors >= a.num_workers,
              "actor.num_actors must be >= actor.num_workers in process mode"),
             (l.publish_every >= 1, "learner.publish_every must be >= 1"),
+            (l.checkpoint_base_every >= 1,
+             "learner.checkpoint_base_every must be >= 1"),
             (l.replay_sample_size >= 1, "learner.replay_sample_size must be >= 1"),
             (l.q_target_sync_freq >= 1, "learner.q_target_sync_freq must be >= 1"),
             (r.capacity >= l.replay_sample_size,

@@ -151,6 +151,12 @@ class DedupReplay:
         self._resolver = CarryResolver()
         self._frame_dead = 0
         self._lock = threading.Lock()
+        # Incremental-checkpoint dirty tracking (utils/checkpoint_inc):
+        # (count, cursor, fcount) at the last delta snapshot + the sparse
+        # indices restamped/swept since.  None = next snapshot is a base.
+        self._ckpt = None
+        self._dirty: list = []
+        self._dirty_rows = 0
 
     # -- write path (actors / drain) ------------------------------------
 
@@ -212,6 +218,16 @@ class DedupReplay:
             self._tree.set(di, np.zeros(len(di)))
             self._alive[di] = False
             self._frame_dead += len(di)
+            self._track_dirty_locked(di)
+
+    def _track_dirty_locked(self, indices: np.ndarray) -> None:
+        if self._ckpt is None:
+            return
+        self._dirty.append(np.array(indices, np.int64, copy=True))
+        self._dirty_rows += len(indices)
+        if self._dirty_rows > 4 * self.capacity:
+            # Overflow guard: sparse record rivals a base — retrack.
+            self._dirty, self._dirty_rows, self._ckpt = [], 0, None
 
     # -- read path (learner) --------------------------------------------
 
@@ -268,6 +284,7 @@ class DedupReplay:
                         np.maximum(priorities[live], 1e-12), self.alpha
                     ),
                 )
+                self._track_dirty_locked(indices[live])
 
     # -- misc ------------------------------------------------------------
 
@@ -300,27 +317,142 @@ class DedupReplay:
 
     def state_dict(self) -> dict:
         with self._lock:
-            size = min(self._count, self.capacity)
-            idx = np.arange(size)
-            nf = min(self._fcount, self.frame_capacity)
+            return self._state_dict_locked()
+
+    def _state_dict_locked(self) -> dict:
+        size = min(self._count, self.capacity)
+        idx = np.arange(size)
+        nf = min(self._fcount, self.frame_capacity)
+        src_ids, src_state = self._resolver.state_arrays()
+        return {
+            "dedup": np.asarray(True),
+            "frames": self._frames[:nf].copy(),
+            "obs_seq": self._obs_seq[:size].copy(),
+            "next_seq": self._next_seq[:size].copy(),
+            "action": self._action[:size].copy(),
+            "reward": self._reward[:size].copy(),
+            "discount": self._discount[:size].copy(),
+            "alive": self._alive[:size].copy(),
+            "tree_priorities": self._tree.get(idx),
+            "cursor": self._cursor,
+            "count": self._count,
+            "fcount": self._fcount,
+            "frame_dead": self._frame_dead,
+            "dropped_carry": self._resolver.dropped_carry,
+            "frame_capacity": self.frame_capacity,
+            "src_ids": src_ids,
+            "src_state": src_state,
+        }
+
+    # -- incremental snapshot (utils/checkpoint_inc delta protocol) -------
+
+    def delta_state_dict(self, force_base: bool = False) -> dict:
+        """Base or dirty-span delta since the last snapshot.  The frame
+        ring and transition ring write sequentially at cursors, so the
+        delta is the two spans written since the mark plus the sparse
+        restamped/swept priorities — bytes ∝ checkpoint interval, not the
+        17.6 GB ring (the whole point; see checkpoint_inc)."""
+        with self._lock:
+            prev = self._ckpt
+            n_new = self._count - (prev[0] if prev else 0)
+            f_new = self._fcount - (prev[2] if prev else 0)
+            if (force_base or prev is None or n_new >= self.capacity
+                    or f_new >= self.frame_capacity):
+                out = self._state_dict_locked()
+                out["chain_mark"] = np.asarray(
+                    [self._count, self._fcount], np.int64
+                )
+                self._mark_locked()
+                return out
+            prev_count, prev_cursor, prev_fcount = prev
+            span = (prev_cursor + np.arange(n_new)) % self.capacity
+            fspan = (prev_fcount + np.arange(f_new)) % self.frame_capacity
+            dirty = self._drain_dirty_locked()
             src_ids, src_state = self._resolver.state_arrays()
-            return {
+            out = {
+                "delta": np.asarray(True),
                 "dedup": np.asarray(True),
-                "frames": self._frames[:nf].copy(),
-                "obs_seq": self._obs_seq[:size].copy(),
-                "next_seq": self._next_seq[:size].copy(),
-                "action": self._action[:size].copy(),
-                "reward": self._reward[:size].copy(),
-                "discount": self._discount[:size].copy(),
-                "alive": self._alive[:size].copy(),
-                "tree_priorities": self._tree.get(idx),
+                "chain_prev": np.asarray([prev_count, prev_fcount], np.int64),
+                "chain_mark": np.asarray(
+                    [self._count, self._fcount], np.int64
+                ),
+                "span_idx": span,
+                "span_obs_seq": self._obs_seq[span].copy(),
+                "span_next_seq": self._next_seq[span].copy(),
+                "span_action": self._action[span].copy(),
+                "span_reward": self._reward[span].copy(),
+                "span_discount": self._discount[span].copy(),
+                "span_alive": self._alive[span].copy(),
+                "span_tree": self._tree.get(span),
+                "fspan_idx": fspan,
+                "fspan_frames": self._frames[fspan].copy(),
+                "prio_idx": dirty,
+                "prio_mass": self._tree.get(dirty),
+                "prio_alive": self._alive[dirty].copy(),
                 "cursor": self._cursor,
                 "count": self._count,
                 "fcount": self._fcount,
+                "frame_dead": self._frame_dead,
+                "dropped_carry": self._resolver.dropped_carry,
                 "frame_capacity": self.frame_capacity,
                 "src_ids": src_ids,
                 "src_state": src_state,
             }
+            self._mark_locked()
+            return out
+
+    def _mark_locked(self) -> None:
+        self._ckpt = (self._count, self._cursor, self._fcount)
+        self._dirty, self._dirty_rows = [], 0
+
+    def _drain_dirty_locked(self) -> np.ndarray:
+        if not self._dirty:
+            return np.zeros((0,), np.int64)
+        idx = np.unique(np.concatenate(self._dirty))
+        return idx[(idx >= 0) & (idx < self.capacity)]
+
+    def apply_delta_state_dict(self, delta: dict) -> None:
+        """Restore-side replay of one delta; chain discontinuities raise."""
+        with self._lock:
+            if "delta" not in delta:
+                raise ValueError("not a delta snapshot (missing 'delta' key)")
+            if int(delta["frame_capacity"]) != self.frame_capacity:
+                raise ValueError(
+                    f"delta frame ring {int(delta['frame_capacity'])} != "
+                    f"configured {self.frame_capacity}"
+                )
+            prev = np.asarray(delta["chain_prev"]).reshape(-1)
+            if int(prev[0]) != self._count or int(prev[1]) != self._fcount:
+                raise ValueError(
+                    f"delta chain discontinuity: delta continues "
+                    f"(count, fcount)=({int(prev[0])}, {int(prev[1])}), "
+                    f"replay is at ({self._count}, {self._fcount})"
+                )
+            span = np.asarray(delta["span_idx"], np.int64)
+            fspan = np.asarray(delta["fspan_idx"], np.int64)
+            self._frames[fspan] = delta["fspan_frames"]
+            self._obs_seq[span] = delta["span_obs_seq"]
+            self._next_seq[span] = delta["span_next_seq"]
+            self._action[span] = delta["span_action"]
+            self._reward[span] = delta["span_reward"]
+            self._discount[span] = delta["span_discount"]
+            self._alive[span] = np.asarray(delta["span_alive"], bool)
+            self._tree.set(span, np.asarray(delta["span_tree"], np.float64))
+            prio_idx = np.asarray(delta["prio_idx"], np.int64)
+            if prio_idx.size:
+                self._tree.set(
+                    prio_idx, np.asarray(delta["prio_mass"], np.float64)
+                )
+                self._alive[prio_idx] = np.asarray(delta["prio_alive"], bool)
+            self._cursor = int(delta["cursor"]) % self.capacity
+            self._count = int(delta["count"])
+            self._fcount = int(delta["fcount"])
+            self._frame_dead = int(delta["frame_dead"])
+            self._resolver.dropped_carry = int(delta["dropped_carry"])
+            self._resolver.load_state_arrays(
+                delta["src_ids"], delta["src_state"]
+            )
+            self._mark_locked()
 
     def load_state_dict(self, state: dict) -> None:
         if "dedup" not in state:
@@ -358,6 +490,11 @@ class DedupReplay:
             self._tree.set(rng, state["tree_priorities"])
             self._cursor = int(state["cursor"]) % self.capacity
             self._count = int(state["count"])
+            # dropped_carry/frame_dead accounting survives resume (absent
+            # in pre-incremental snapshots — degrade to 0, not a crash).
+            self._frame_dead = int(state.get("frame_dead", 0))
+            self._resolver.dropped_carry = int(state.get("dropped_carry", 0))
             self._resolver.load_state_arrays(
                 state["src_ids"], state["src_state"]
             )
+            self._ckpt, self._dirty, self._dirty_rows = None, [], 0

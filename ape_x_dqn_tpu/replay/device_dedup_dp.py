@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ape_x_dqn_tpu.parallel.mesh import shard_map
+
 from ape_x_dqn_tpu.replay.device import fused_scan_body
 from ape_x_dqn_tpu.replay.device_dedup import (
     DedupDeviceReplayState,
@@ -115,7 +117,7 @@ def build_sharded_dedup_add_frames(mesh: Mesh, jit: bool = True):
         def body(st, fr):
             return _packed(dedup_device_add_frames(_local(st), fr[0]))
 
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh, in_specs=(specs, P(_AXIS)), out_specs=specs,
         )(state, frames)
 
@@ -139,7 +141,7 @@ def build_sharded_dedup_add_transitions(
             ))
 
         row = P(_AXIS)
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh,
             in_specs=(specs, row, row, row, row, row, row),
             out_specs=specs,
@@ -189,7 +191,7 @@ def build_sharded_dedup_fused_learn_step(
         loss=P(), mean_abs_td=P(), max_abs_td=P(),
         priorities=P(None, _AXIS), mean_q=P(),
     )
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(), specs, P(), P()),
         out_specs=(P(), specs, metrics_specs),

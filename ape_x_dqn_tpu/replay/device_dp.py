@@ -44,6 +44,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ape_x_dqn_tpu.parallel.mesh import shard_map
+
 from ape_x_dqn_tpu.replay.device import (
     DeviceReplayState,
     device_replay_add,
@@ -126,7 +128,7 @@ def build_sharded_replay_add(
                 device_replay_add(_local(st), ch, pr, priority_exponent)
             )
 
-        return jax.shard_map(
+        return shard_map(
             body, mesh=mesh,
             in_specs=(specs, P(_AXIS), P(_AXIS)),
             out_specs=specs,
@@ -192,7 +194,7 @@ def build_sharded_fused_learn_step(
         loss=P(), mean_abs_td=P(), max_abs_td=P(),
         priorities=P(None, _AXIS), mean_q=P(),
     )
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(), specs, P(), P()),
         out_specs=(P(), specs, metrics_specs),

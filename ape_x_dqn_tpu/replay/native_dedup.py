@@ -109,6 +109,32 @@ def _load():
                 _i64p, _i64p, _i32p, _f32p, _f32p, _u8p, _f64p,
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ]
+            # Incremental-snapshot surface (dirty spans + sparse).
+            lib.rc_export_alive.argtypes = [ctypes.c_void_p, _u8p]
+            lib.rc_export_frames_span.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, _u8p,
+            ]
+            lib.rc_import_frames_span.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, _u8p,
+            ]
+            lib.rc_export_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                _i64p, _i64p, _i32p, _f32p, _f32p, _u8p, _f64p,
+            ]
+            lib.rc_import_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                _i64p, _i64p, _i32p, _f32p, _f32p, _u8p, _f64p,
+            ]
+            lib.rc_export_mass.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, _i64p, _f64p,
+            ]
+            lib.rc_apply_sparse.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, _i64p, _u8p, _f64p,
+            ]
+            lib.rc_set_counters.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64,
+            ]
             _lib = lib
         except Exception as e:  # compiler missing, build/load failure
             _lib_err = f"{type(e).__name__}: {e}"
@@ -160,6 +186,13 @@ class NativeDedupReplay:
             raise MemoryError("rc_create failed")
         self._resolver = CarryResolver()
         self._lock = threading.Lock()
+        # Incremental-checkpoint dirty tracking (utils/checkpoint_inc):
+        # (count, cursor, fcount, alive copy) at the last snapshot; the
+        # liveness sweep runs inside rc_add, so swept slots are recovered
+        # by diffing the alive vector instead of recording indices.
+        self._ckpt = None
+        self._dirty: list = []
+        self._dirty_rows = 0
 
     def __del__(self):
         h = getattr(self, "_handle", None)
@@ -244,6 +277,13 @@ class NativeDedupReplay:
             self._lib.rc_update(
                 self._handle, idx.shape[0], _p(idx, _i64p), _p(prio, _f32p)
             )
+            if self._ckpt is not None:
+                self._dirty.append(idx.copy())
+                self._dirty_rows += idx.shape[0]
+                if self._dirty_rows > 4 * self.capacity:
+                    # Sparse record rivals a base — retrack from scratch.
+                    self._dirty, self._dirty_rows = [], 0
+                    self._ckpt = None
 
     # -- misc ------------------------------------------------------------
 
@@ -272,35 +312,187 @@ class NativeDedupReplay:
 
     def state_dict(self) -> dict:
         with self._lock:
-            size = self.size()
-            nf = min(int(self._lib.rc_fcount(self._handle)),
-                     self.frame_capacity)
-            frames = np.empty((nf, *self.obs_shape), np.uint8)
-            obs_seq = np.empty(size, np.int64)
-            next_seq = np.empty(size, np.int64)
-            action = np.empty(size, np.int32)
-            reward = np.empty(size, np.float32)
-            discount = np.empty(size, np.float32)
-            alive = np.empty(size, np.uint8)
-            mass = np.empty(size, np.float64)
-            self._lib.rc_export(
-                self._handle, _p(frames, _u8p), _p(obs_seq, _i64p),
+            return self._state_dict_locked()
+
+    def _state_dict_locked(self) -> dict:
+        size = self.size()
+        nf = min(int(self._lib.rc_fcount(self._handle)),
+                 self.frame_capacity)
+        frames = np.empty((nf, *self.obs_shape), np.uint8)
+        obs_seq = np.empty(size, np.int64)
+        next_seq = np.empty(size, np.int64)
+        action = np.empty(size, np.int32)
+        reward = np.empty(size, np.float32)
+        discount = np.empty(size, np.float32)
+        alive = np.empty(size, np.uint8)
+        mass = np.empty(size, np.float64)
+        self._lib.rc_export(
+            self._handle, _p(frames, _u8p), _p(obs_seq, _i64p),
+            _p(next_seq, _i64p), _p(action, _i32p), _p(reward, _f32p),
+            _p(discount, _f32p), _p(alive, _u8p), _p(mass, _f64p),
+        )
+        src_ids, src_state = self._resolver.state_arrays()
+        return {
+            "dedup": np.asarray(True),
+            "frames": frames, "obs_seq": obs_seq, "next_seq": next_seq,
+            "action": action, "reward": reward, "discount": discount,
+            "alive": alive.astype(bool),
+            "tree_priorities": mass,
+            "cursor": int(self._lib.rc_cursor(self._handle)),
+            "count": self.total_added,
+            "fcount": int(self._lib.rc_fcount(self._handle)),
+            "frame_dead": int(self._lib.rc_frame_dead(self._handle)),
+            "dropped_carry": self._resolver.dropped_carry,
+            "frame_capacity": self.frame_capacity,
+            "src_ids": src_ids, "src_state": src_state,
+        }
+
+    # -- incremental snapshot (utils/checkpoint_inc delta protocol) -------
+    # Dict format is IDENTICAL to DedupReplay's delta — chains written by
+    # either implementation restore into the other (the numpy twin stays
+    # the native core's oracle all the way through checkpointing).
+
+    def delta_state_dict(self, force_base: bool = False) -> dict:
+        with self._lock:
+            count = self.total_added
+            fcount = int(self._lib.rc_fcount(self._handle))
+            cursor = int(self._lib.rc_cursor(self._handle))
+            prev = self._ckpt
+            n_new = count - (prev[0] if prev else 0)
+            f_new = fcount - (prev[2] if prev else 0)
+            if (force_base or prev is None or n_new >= self.capacity
+                    or f_new >= self.frame_capacity):
+                out = self._state_dict_locked()
+                out["chain_mark"] = np.asarray([count, fcount], np.int64)
+                self._mark_locked(count, cursor, fcount)
+                return out
+            prev_count, prev_cursor, prev_fcount, alive_mark = prev
+            span = (prev_cursor + np.arange(n_new)) % self.capacity
+            obs_seq = np.empty(n_new, np.int64)
+            next_seq = np.empty(n_new, np.int64)
+            action = np.empty(n_new, np.int32)
+            reward = np.empty(n_new, np.float32)
+            discount = np.empty(n_new, np.float32)
+            alive = np.empty(n_new, np.uint8)
+            mass = np.empty(n_new, np.float64)
+            self._lib.rc_export_rows(
+                self._handle, prev_cursor, n_new, _p(obs_seq, _i64p),
                 _p(next_seq, _i64p), _p(action, _i32p), _p(reward, _f32p),
                 _p(discount, _f32p), _p(alive, _u8p), _p(mass, _f64p),
             )
+            fspan = (prev_fcount + np.arange(f_new)) % self.frame_capacity
+            frames = np.empty((f_new, *self.obs_shape), np.uint8)
+            self._lib.rc_export_frames_span(
+                self._handle, prev_fcount, f_new, _p(frames, _u8p)
+            )
+            # Sparse: recorded restamps ∪ sweep-invalidated (alive diff —
+            # the sweep runs inside rc_add, C-side).
+            alive_now = np.empty(self.capacity, np.uint8)
+            self._lib.rc_export_alive(self._handle, _p(alive_now, _u8p))
+            parts = [np.nonzero(alive_mark != alive_now)[0]]
+            if self._dirty:
+                parts.append(np.concatenate(self._dirty))
+            dirty = np.unique(np.concatenate(parts))
+            dirty = np.ascontiguousarray(
+                dirty[(dirty >= 0) & (dirty < self.capacity)]
+            )
+            dmass = np.empty(dirty.shape[0], np.float64)
+            self._lib.rc_export_mass(
+                self._handle, dirty.shape[0], _p(dirty, _i64p),
+                _p(dmass, _f64p),
+            )
             src_ids, src_state = self._resolver.state_arrays()
-            return {
+            out = {
+                "delta": np.asarray(True),
                 "dedup": np.asarray(True),
-                "frames": frames, "obs_seq": obs_seq, "next_seq": next_seq,
-                "action": action, "reward": reward, "discount": discount,
-                "alive": alive.astype(bool),
-                "tree_priorities": mass,
-                "cursor": int(self._lib.rc_cursor(self._handle)),
-                "count": self.total_added,
-                "fcount": int(self._lib.rc_fcount(self._handle)),
+                "chain_prev": np.asarray([prev_count, prev_fcount], np.int64),
+                "chain_mark": np.asarray([count, fcount], np.int64),
+                "span_idx": span,
+                "span_obs_seq": obs_seq,
+                "span_next_seq": next_seq,
+                "span_action": action,
+                "span_reward": reward,
+                "span_discount": discount,
+                "span_alive": alive.astype(bool),
+                "span_tree": mass,
+                "fspan_idx": fspan,
+                "fspan_frames": frames,
+                "prio_idx": dirty,
+                "prio_mass": dmass,
+                "prio_alive": alive_now[dirty].astype(bool),
+                "cursor": cursor,
+                "count": count,
+                "fcount": fcount,
+                "frame_dead": int(self._lib.rc_frame_dead(self._handle)),
+                "dropped_carry": self._resolver.dropped_carry,
                 "frame_capacity": self.frame_capacity,
-                "src_ids": src_ids, "src_state": src_state,
+                "src_ids": src_ids,
+                "src_state": src_state,
             }
+            self._mark_locked(count, cursor, fcount, alive_now)
+            return out
+
+    def _mark_locked(self, count, cursor, fcount, alive_now=None) -> None:
+        if alive_now is None:
+            alive_now = np.empty(self.capacity, np.uint8)
+            self._lib.rc_export_alive(self._handle, _p(alive_now, _u8p))
+        self._ckpt = (count, cursor, fcount, alive_now)
+        self._dirty, self._dirty_rows = [], 0
+
+    def apply_delta_state_dict(self, delta: dict) -> None:
+        with self._lock:
+            if "delta" not in delta:
+                raise ValueError("not a delta snapshot (missing 'delta' key)")
+            if int(delta["frame_capacity"]) != self.frame_capacity:
+                raise ValueError(
+                    f"delta frame ring {int(delta['frame_capacity'])} != "
+                    f"configured {self.frame_capacity}"
+                )
+            prev = np.asarray(delta["chain_prev"]).reshape(-1)
+            count, fcount = self.total_added, int(
+                self._lib.rc_fcount(self._handle)
+            )
+            if int(prev[0]) != count or int(prev[1]) != fcount:
+                raise ValueError(
+                    f"delta chain discontinuity: delta continues "
+                    f"(count, fcount)=({int(prev[0])}, {int(prev[1])}), "
+                    f"replay is at ({count}, {fcount})"
+                )
+            n_new = int(delta["count"]) - int(prev[0])
+            f_new = int(delta["fcount"]) - int(prev[1])
+            start = (int(delta["cursor"]) - n_new) % self.capacity
+            self._lib.rc_import_rows(
+                self._handle, start, n_new,
+                _p(np.ascontiguousarray(delta["span_obs_seq"], np.int64), _i64p),
+                _p(np.ascontiguousarray(delta["span_next_seq"], np.int64), _i64p),
+                _p(np.ascontiguousarray(delta["span_action"], np.int32), _i32p),
+                _p(np.ascontiguousarray(delta["span_reward"], np.float32), _f32p),
+                _p(np.ascontiguousarray(delta["span_discount"], np.float32), _f32p),
+                _p(np.ascontiguousarray(delta["span_alive"], np.uint8), _u8p),
+                _p(np.ascontiguousarray(delta["span_tree"], np.float64), _f64p),
+            )
+            self._lib.rc_import_frames_span(
+                self._handle, int(prev[1]), f_new,
+                _p(np.ascontiguousarray(delta["fspan_frames"], np.uint8), _u8p),
+            )
+            pidx = np.ascontiguousarray(delta["prio_idx"], np.int64)
+            self._lib.rc_apply_sparse(
+                self._handle, pidx.shape[0], _p(pidx, _i64p),
+                _p(np.ascontiguousarray(delta["prio_alive"], np.uint8), _u8p),
+                _p(np.ascontiguousarray(delta["prio_mass"], np.float64), _f64p),
+            )
+            self._lib.rc_set_counters(
+                self._handle, int(delta["cursor"]), int(delta["count"]),
+                int(delta["fcount"]), int(delta["frame_dead"]),
+            )
+            self._resolver.dropped_carry = int(delta["dropped_carry"])
+            self._resolver.load_state_arrays(
+                delta["src_ids"], delta["src_state"]
+            )
+            self._mark_locked(
+                int(delta["count"]), int(delta["cursor"]),
+                int(delta["fcount"]),
+            )
 
     def load_state_dict(self, state: dict) -> None:
         if "dedup" not in state:
@@ -331,6 +523,15 @@ class NativeDedupReplay:
             )
             if rc != 0:
                 raise ValueError("rc_import rejected the snapshot")
+            # Accounting parity with the numpy twin: dropped_carry /
+            # frame_dead survive resume (pre-incremental snapshots lack
+            # the keys — degrade to 0).
+            self._lib.rc_set_counters(
+                self._handle, int(state["cursor"]), int(state["count"]),
+                int(state["fcount"]), int(state.get("frame_dead", 0)),
+            )
+            self._resolver.dropped_carry = int(state.get("dropped_carry", 0))
             self._resolver.load_state_arrays(
                 state["src_ids"], state["src_state"]
             )
+            self._ckpt, self._dirty, self._dirty_rows = None, [], 0

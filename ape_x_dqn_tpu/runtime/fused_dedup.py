@@ -338,11 +338,14 @@ class FusedDedupLearner:
 
             n = mesh.shape["data"]
             self._n_shards = n
-            # Identity-jit, not device_put: the fused call donates this
-            # state and an aliased placement would free the caller's copy.
-            self._state = jax.jit(
-                lambda s: s, out_shardings=NamedSharding(mesh, P())
-            )(state)
+            # Host round trip, not device_put/identity-jit on the device
+            # arrays: the fused call donates this state so an aliased
+            # placement would free the caller's copy, and an identity jit
+            # can't rebuffer arrays COMMITTED to one device (the
+            # checkpoint-restore path places them so).  Init-time only.
+            self._state = jax.device_put(
+                jax.device_get(state), NamedSharding(mesh, P())
+            )
             self._replay = init_sharded_dedup_replay(
                 capacity, obs_shape, mesh, frame_ratio=frame_ratio
             )
@@ -375,7 +378,10 @@ class FusedDedupLearner:
                 place(blk["discount"]),
                 place(blk["prio"]),
             )
-        self._rng = jax.random.fold_in(state.rng, 0x5EED)
+        # self._state's rng, not the caller's: under a mesh the state
+        # was re-placed replicated above — a restored state's rng arrives
+        # COMMITTED to one device and would conflict with the mesh call.
+        self._rng = jax.random.fold_in(self._state.rng, 0x5EED)
         self._stager = DedupStager(self._n_shards)
         # learner.ingest_block is the TOTAL rows per ingest dispatch
         # (FusedDeviceLearner contract); the stager takes per-shard blocks.
@@ -387,6 +393,14 @@ class FusedDedupLearner:
         self._ingest_block //= self._n_shards
         self._lock = threading.Lock()
         self._size = 0
+        # Incremental-checkpoint mark (utils/checkpoint_inc): per-shard
+        # ingest/ship progress at the last snapshot.  Both counters are
+        # HOST-side monotone ints (every shard ingests identical block
+        # rows; the stager's shipped_f is the true frame count the device
+        # ring's mod-Q fcount wraps), so computing the dirty spans needs
+        # NO device read — the learner thread only dispatches the span
+        # gathers and the writer thread does the device_get.
+        self._ckpt = None  # (ingested_rows_per_shard, (shipped_f per shard))
 
     # ------------------------------------------------------------- sinks
 
@@ -501,6 +515,165 @@ class FusedDedupLearner:
             out[f"stage_{k}"] = v
         return out
 
+    # -- incremental snapshot (utils/checkpoint_inc delta protocol) -------
+
+    def _chain_now(self):
+        """(ingested rows per shard, shipped frames per shard) — host-side
+        monotone progress counters (see the _ckpt comment in __init__)."""
+        return (self._size // self._n_shards,
+                tuple(s.shipped_f for s in self._stager.shards))
+
+    def delta_state_dict(self, force_base: bool = False) -> dict:
+        """Base or per-shard dirty-span delta.  The learner thread only
+        computes span indices (host ints) and DISPATCHES the gathers
+        (jnp.take — new device buffers, immune to the fused call's
+        donation); np.asarray materialization is the writer thread's job.
+        The mass vector rides whole each delta (the fused scan restamps
+        arbitrary rows; at 4 bytes/slot it is noise next to the frame
+        spans), as does the staged-chunk state (bounded by ingest cadence).
+        Must run on the train()-caller thread, like every device op here.
+        """
+        import jax.numpy as jnp
+
+        n = self._n_shards
+        C_local = self._capacity // n
+        Cf_global = int(self._replay.frames.shape[0])
+        Cf_local = Cf_global // n
+        with self._lock:
+            ing_now, shipped_now = self._chain_now()
+            prev = self._ckpt
+        new_rows = ing_now - (prev[0] if prev else 0)
+        f_new = [
+            shipped_now[d] - (prev[1][d] if prev else 0)
+            for d in range(n)
+        ]
+        if (force_base or prev is None or new_rows >= C_local
+                or max(f_new) >= Cf_local):
+            # ing/shipped only advance on this (the learner) thread, so the
+            # full snapshot below cannot drift from the mark taken here.
+            out = self.state_dict()
+            out["chain_mark"] = np.asarray([ing_now, *shipped_now], np.int64)
+            with self._lock:
+                self._ckpt = (ing_now, shipped_now)
+            return out
+        ing_prev, shipped_prev = prev
+        with self._lock:
+            # Transition span: every shard ingests identical block rows, so
+            # one local window maps to all shards.
+            local = (ing_prev + np.arange(new_rows)) % C_local
+            tidx = np.concatenate(
+                [d * C_local + local for d in range(n)]
+            ).astype(np.int32) if new_rows else np.zeros(0, np.int32)
+            fidx = np.concatenate([
+                d * Cf_local
+                + (shipped_prev[d] + np.arange(f_new[d])) % Cf_local
+                for d in range(n)
+            ]).astype(np.int32) if sum(f_new) else np.zeros(0, np.int32)
+            stage = self._stager.state_dict()
+            self._ckpt = (ing_now, shipped_now)
+        r = self._replay
+        ti = jnp.asarray(tidx)
+        fi = jnp.asarray(fidx)
+        out = {
+            "delta": np.asarray(True),
+            "dedup": np.asarray(True),
+            "n_shards": n,
+            "chain_prev": np.asarray([ing_prev, *shipped_prev], np.int64),
+            "chain_mark": np.asarray([ing_now, *shipped_now], np.int64),
+            "txn_gidx": tidx,
+            "txn_obs_ref": jnp.take(r.obs_ref, ti, axis=0),
+            "txn_next_ref": jnp.take(r.next_ref, ti, axis=0),
+            "txn_action": jnp.take(r.action, ti, axis=0),
+            "txn_reward": jnp.take(r.reward, ti, axis=0),
+            "txn_discount": jnp.take(r.discount, ti, axis=0),
+            "frame_gidx": fidx,
+            "frame_rows": jnp.take(r.frames, fi, axis=0),
+            "mass": jnp.copy(r.mass),
+            # Counters recomputed host-side — bit-identical to the device's
+            # mod-C / saturating / mod-Q arithmetic, no device sync needed.
+            "cursor": np.asarray(
+                [ing_now % C_local] * n, np.int32
+            ),
+            "count": np.asarray(
+                [min(ing_now, 1 << 30)] * n, np.int32
+            ),
+            "fcount": np.asarray(
+                [s % self._seq_mod for s in shipped_now], np.int32
+            ),
+            "capacity": self._capacity,
+            "frame_capacity": Cf_global,
+        }
+        for k, v in stage.items():
+            out[f"stage_{k}"] = v
+        return out
+
+    def apply_delta_state_dict(self, delta: dict) -> None:
+        if "delta" not in delta:
+            raise ValueError("not a delta snapshot (missing 'delta' key)")
+        if int(delta["n_shards"]) != self._n_shards:
+            raise ValueError(
+                f"delta has {int(delta['n_shards'])} shards, configured "
+                f"{self._n_shards}"
+            )
+        if (int(delta["capacity"]) != self._capacity
+                or int(delta["frame_capacity"])
+                != int(self._replay.frames.shape[0])):
+            raise ValueError("delta ring layout != configured layout")
+        with self._lock:
+            ing_now, shipped_now = self._chain_now()
+            prev = np.asarray(delta["chain_prev"]).reshape(-1)
+            if (int(prev[0]) != ing_now
+                    or tuple(int(x) for x in prev[1:]) != shipped_now):
+                raise ValueError(
+                    f"delta chain discontinuity: delta continues "
+                    f"{tuple(int(x) for x in prev)}, replay is at "
+                    f"{(ing_now, *shipped_now)}"
+                )
+        import jax.numpy as jnp
+
+        r = self._replay
+        if self._mesh is not None:
+            place = lambda key, live: jax.device_put(  # noqa: E731
+                np.asarray(delta[key]).reshape(live.shape), live.sharding
+            )
+        else:
+            place = lambda key, live: jnp.asarray(  # noqa: E731
+                np.asarray(delta[key]).reshape(live.shape)
+            )
+        ti = jnp.asarray(np.asarray(delta["txn_gidx"], np.int32))
+        fi = jnp.asarray(np.asarray(delta["frame_gidx"], np.int32))
+        from ape_x_dqn_tpu.replay.device_dedup import DedupDeviceReplayState
+
+        self._replay = DedupDeviceReplayState(
+            frames=r.frames.at[fi].set(jnp.asarray(delta["frame_rows"])),
+            obs_ref=r.obs_ref.at[ti].set(
+                jnp.asarray(np.asarray(delta["txn_obs_ref"], np.int32))
+            ),
+            next_ref=r.next_ref.at[ti].set(
+                jnp.asarray(np.asarray(delta["txn_next_ref"], np.int32))
+            ),
+            action=r.action.at[ti].set(
+                jnp.asarray(np.asarray(delta["txn_action"], np.int32))
+            ),
+            reward=r.reward.at[ti].set(
+                jnp.asarray(np.asarray(delta["txn_reward"], np.float32))
+            ),
+            discount=r.discount.at[ti].set(
+                jnp.asarray(np.asarray(delta["txn_discount"], np.float32))
+            ),
+            mass=place("mass", r.mass),
+            cursor=place("cursor", r.cursor),
+            count=place("count", r.count),
+            fcount=place("fcount", r.fcount),
+        )
+        with self._lock:
+            self._stager.load_state_dict({
+                k[len("stage_"):]: np.asarray(v) for k, v in delta.items()
+                if k.startswith("stage_")
+            })
+            self._size = int(np.sum(np.asarray(delta["count"])))
+            self._ckpt = self._chain_now()
+
     def load_state_dict(self, state: dict) -> None:
         if "dedup" not in state:
             raise ValueError(
@@ -543,6 +716,10 @@ class FusedDedupLearner:
                 k[len("stage_"):]: v for k, v in state.items()
                 if k.startswith("stage_")
             })
+            # Full load invalidates dirty-span tracking: next incremental
+            # save is a base unless deltas follow (checkpoint_inc applies
+            # them via apply_delta_state_dict, which re-marks).
+            self._ckpt = None
 
     def train(self, beta: float):
         self._rng, sub = jax.random.split(self._rng)

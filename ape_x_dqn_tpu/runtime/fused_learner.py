@@ -106,13 +106,16 @@ class FusedDeviceLearner:
                     f"data-axis extent {n}"
                 )
             # Train state replicated over the mesh; the grad pmean inside
-            # the step keeps every replica identical.  Identity-jit (not
-            # device_put): device_put may alias the caller's buffers when
-            # layouts line up, and the fused call donates this state — an
-            # alias would delete the caller's arrays out from under it.
-            self._state = jax.jit(
-                lambda s: s, out_shardings=NamedSharding(mesh, P())
-            )(state)
+            # the step keeps every replica identical.  Host round trip, not
+            # device_put/identity-jit on the device arrays: device_put may
+            # alias the caller's buffers when layouts line up (the fused
+            # call donates this state — an alias would delete the caller's
+            # arrays out from under it), and an identity jit can't rebuffer
+            # arrays COMMITTED to one device (the checkpoint-restore path
+            # places them so).  Init-time cost only.
+            self._state = jax.device_put(
+                jax.device_get(state), NamedSharding(mesh, P())
+            )
             self._replay = init_sharded_device_replay(
                 capacity, obs_shape, mesh
             )
@@ -145,7 +148,10 @@ class FusedDeviceLearner:
         # Distinct per-seed sampling stream: fold a salt into the state's key
         # (reading a key word breaks — the high word is 0 for seeds < 2^32,
         # which made every seed sample identically; round-2 advisor finding).
-        self._rng = jax.random.fold_in(state.rng, 0x5EED)
+        # self._state's rng, not the caller's: under a mesh the state
+        # was re-placed replicated above — a restored state's rng arrives
+        # COMMITTED to one device and would conflict with the mesh call.
+        self._rng = jax.random.fold_in(self._state.rng, 0x5EED)
         # Host staging: numpy transitions accumulate here until a full
         # fixed-size block exists (static shapes → one compiled ingest).
         self._lock = threading.Lock()

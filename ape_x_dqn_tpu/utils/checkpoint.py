@@ -12,6 +12,10 @@ are uint8 so a snapshot is exactly the buffer's RAM footprint).
 Layout under ``<dir>/``:
     step_<N>/state/   — orbax pytree checkpoint of the TrainState
     step_<N>/replay.npz — optional replay snapshot
+    replay_inc<sfx>/  — incremental replay chain (base + delta chunks +
+                        MANIFEST.json; utils/checkpoint_inc, written when
+                        learner.checkpoint_incremental — then no per-step
+                        npz exists and restore falls back to the chain)
 ``latest_step`` finds the newest complete checkpoint; partial writes are
 ignored because orbax commits atomically (tmp dir + rename).
 """
@@ -151,14 +155,20 @@ def restore_checkpoint(
         state_template,
         state,
     )
-    if replay is not None and not load_replay_snapshot(
+    if replay is not None and load_replay_leg(
         path, replay, replay_suffix=replay_suffix
-    ):
+    ) is None:
         # Loud, not silent: resuming without the buffer is a degraded
-        # restart (the learner retrains on an empty replay).
-        print(
-            f"WARNING: checkpoint {path} has no replay snapshot "
-            f"(replay{replay_suffix}.npz) — resuming with an empty buffer"
+        # restart (the learner retrains on an empty replay).  A structured
+        # event on the metrics stream (machine-readable JSONL), not a bare
+        # print — driver tooling greps for it.
+        from ape_x_dqn_tpu.utils.metrics import emit_event
+
+        emit_event(
+            "checkpoint_restore_missing_replay",
+            path=path,
+            replay_file=f"replay{replay_suffix}.npz",
+            consequence="resuming with an empty buffer",
         )
     return state, int(jax.device_get(state.step))
 
@@ -179,6 +189,37 @@ def load_replay_snapshot(root_or_path: str, replay,
     with np.load(replay_file) as z:
         replay.load_state_dict({k: z[k] for k in z.files})
     return True
+
+
+def load_replay_leg(root_or_path: str, replay,
+                    replay_suffix: str = "") -> Optional[str]:
+    """Restore the replay from whichever leg the checkpoint has: the
+    step dir's ``replay<suffix>.npz`` snapshot first, else the committed
+    incremental chain under ``<root>/replay_inc<suffix>/``
+    (utils/checkpoint_inc — the learner.checkpoint_incremental save path
+    writes no per-step npz at all).  Returns ``"snapshot"``,
+    ``"incremental"``, or None when the checkpoint has no replay leg.
+
+    A chain the manifest references but whose chunk fails its CRC raises
+    ``checkpoint_inc.ChunkCorrupt`` — real corruption is never silently
+    degraded to an empty buffer.
+    """
+    try:
+        if load_replay_snapshot(root_or_path, replay,
+                                replay_suffix=replay_suffix):
+            return "snapshot"
+    except FileNotFoundError:
+        pass  # no committed step dir at all — the chain may still exist
+    from ape_x_dqn_tpu.utils.checkpoint_inc import load_incremental_replay
+
+    # The chain lives under the checkpoint ROOT (it spans steps); an
+    # explicit step_N path resolves to its parent.
+    root = os.path.abspath(root_or_path)
+    if _STEP_RE.match(os.path.basename(root)):
+        root = os.path.dirname(root)
+    if load_incremental_replay(root, replay, suffix=replay_suffix) is not None:
+        return "incremental"
+    return None
 
 
 def _prune(root: str, keep: int) -> None:

@@ -1,0 +1,415 @@
+"""Incremental async replay checkpointing — the snapshot off the learner's
+critical path.
+
+``save_checkpoint`` (utils/checkpoint.py) serializes the ENTIRE replay
+inline on the learner thread: at config3 scale the dedup frame ring is
+~17.6 GB (PROFILE.md round 5) — minutes of dead air per checkpoint, exactly
+the stall Ape-X decouples actors/learner to avoid, and the same
+off-critical-path discipline orbax's async checkpointing applies to params.
+This module replaces the replay leg with an incremental, non-blocking
+subsystem:
+
+  * **Dirty-span deltas** — the dedup frame ring and transition ring write
+    sequentially at cursors, so between checkpoints only the span written
+    since the last save has changed, plus a sparse set of restamped/swept
+    priorities the replay records as it mutates.  The replay-side protocol
+    is ``delta_state_dict(force_base=False)`` (a base snapshot or a chained
+    delta, both flat str→array dicts) + ``apply_delta_state_dict(delta)``
+    (restore-side replay of one delta); every dict carries a ``chain_mark``
+    (counters after) and deltas a ``chain_prev`` (counters before) so a
+    break in the chain is detected, never silently composed.  Delta bytes
+    are proportional to the checkpoint INTERVAL, not the ring capacity.
+  * **CRC-framed chunk files** — each base/delta is one ``chunk_<G>_<k>``
+    file: an ``APXC`` header (magic | version | flags | payload_len |
+    crc32) over an APXT array-dict payload (the shm_ring wire format —
+    same framing discipline, same decoder).  A truncated or corrupted
+    chunk fails its CRC and is rejected, never half-applied.
+  * **Manifest-last atomic commit** — ``MANIFEST.json`` is rewritten via
+    fsync + ``os.replace`` AFTER every chunk of the save is durable (the
+    same commit-ordering contract save_checkpoint documents for the
+    ``state/`` marker).  A SIGKILL mid-delta-write leaves an uncommitted
+    tail file the manifest never references; restore falls back to the
+    last manifest.
+  * **Async writer** — the learner thread only takes the replay's snapshot
+    (a bounded memcpy of the dirty span under the replay lock; for device
+    rings, slice dispatches — the ``_AsyncPublisher`` latest-wins pattern
+    from runtime/async_pipeline.py applied to replay bytes).  A writer
+    thread does the ``np.asarray`` materialization (device_get for jax
+    leaves), optional zlib compression, IO, fsync, and the manifest
+    commit.  Backpressure: if a save is still in flight at the next
+    cadence, ``save()`` refuses (counted in ``stats()["inflight_skips"]``)
+    and the NEXT delta simply covers the wider span — deltas chain, so
+    skipping a cadence loses nothing.
+
+Layout under ``<root>/replay_inc<suffix>/``:
+    chunk_<G>_0.ckpt      — generation G's full base snapshot
+    chunk_<G>_<k>.ckpt    — k-th delta after base G (k >= 1)
+    MANIFEST.json         — atomic commit marker, written LAST
+
+A new base starts a new generation; once its manifest commits, prior
+generations' files are pruned (they are unreferenced).  Replays without the
+delta protocol degrade gracefully: every save is a full base, still written
+off-thread (async IO, no dirty-span math).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+import zlib
+from typing import Optional
+
+import numpy as np
+
+# Dependency-light on purpose (stdlib + numpy + the jax-free shm_ring
+# codecs): restore-side tooling and kill-test children must not pay a jax
+# import to read a chunk file.
+from ape_x_dqn_tpu.runtime.shm_ring import pack_array_parts, unpack_arrays
+
+_CHUNK_MAGIC = b"APXC"
+_CHUNK_VERSION = 1
+_FLAG_ZLIB = 1
+# magic 4s | u32 version | u32 flags | u64 payload_len | u32 crc32(payload)
+_CHUNK_HDR = struct.Struct("<4sIIQI")
+
+_MANIFEST = "MANIFEST.json"
+
+
+class ChunkCorrupt(ValueError):
+    """A chunk file failed its CRC / framing check (torn or bit-rotted)."""
+
+
+def inc_dir(root: str, suffix: str = "") -> str:
+    return os.path.join(os.path.abspath(root), f"replay_inc{suffix}")
+
+
+def _chunk_name(gen: int, idx: int) -> str:
+    return f"chunk_{gen}_{idx}.ckpt"
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_chunk(path: str, arrays: dict, compress: bool = False) -> int:
+    """Serialize a flat str→array dict as one CRC-framed chunk file
+    (tmp + fsync + rename — a kill mid-write never leaves a torn file at
+    the committed name).  Returns bytes written."""
+    parts = pack_array_parts({k: np.asarray(v) for k, v in arrays.items()})
+    payload = b"".join(
+        p if isinstance(p, (bytes, bytearray)) else np.asarray(p).tobytes()
+        for p in parts
+    )
+    flags = 0
+    if compress:
+        payload = zlib.compress(payload, 1)
+        flags |= _FLAG_ZLIB
+    header = _CHUNK_HDR.pack(_CHUNK_MAGIC, _CHUNK_VERSION, flags,
+                             len(payload), zlib.crc32(payload))
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(header)
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return _CHUNK_HDR.size + len(payload)
+
+
+def read_chunk(path: str) -> dict:
+    """Decode one chunk file back to its array dict; ``ChunkCorrupt`` on a
+    truncated header/payload or a CRC mismatch."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) < _CHUNK_HDR.size:
+        raise ChunkCorrupt(f"{path}: truncated header "
+                           f"({len(data)} < {_CHUNK_HDR.size} bytes)")
+    magic, version, flags, plen, crc = _CHUNK_HDR.unpack_from(data, 0)
+    if magic != _CHUNK_MAGIC:
+        raise ChunkCorrupt(f"{path}: bad magic {magic!r}")
+    if version != _CHUNK_VERSION:
+        raise ChunkCorrupt(f"{path}: unsupported chunk version {version}")
+    payload = data[_CHUNK_HDR.size:]
+    if len(payload) != plen:
+        raise ChunkCorrupt(
+            f"{path}: truncated payload ({len(payload)} != {plen} bytes)"
+        )
+    if zlib.crc32(payload) != crc:
+        raise ChunkCorrupt(f"{path}: crc mismatch (torn or corrupted chunk)")
+    if flags & _FLAG_ZLIB:
+        payload = zlib.decompress(payload)
+    return unpack_arrays(payload, copy=True)
+
+
+def read_manifest(directory: str) -> Optional[dict]:
+    path = os.path.join(directory, _MANIFEST)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _write_manifest(directory: str, manifest: dict) -> None:
+    """fsync + os.replace: the atomic commit marker, written LAST."""
+    path = os.path.join(directory, _MANIFEST)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(directory)
+
+
+def load_incremental_replay(root: str, replay, suffix: str = "") -> Optional[int]:
+    """Restore ``replay`` from the newest committed manifest under
+    ``<root>/replay_inc<suffix>/``: base first, then every delta in chain
+    order.  Returns the manifest's training step, or None when no committed
+    chain exists.  A chunk the manifest references but that fails its CRC
+    raises ``ChunkCorrupt`` (real corruption — never silently skipped);
+    files beyond the manifest (an uncommitted tail from a killed writer)
+    are ignored.
+    """
+    directory = inc_dir(root, suffix)
+    manifest = read_manifest(directory)
+    if manifest is None:
+        return None
+    chunks = manifest["chunks"]
+    if not chunks:
+        return None
+    base = read_chunk(os.path.join(directory, chunks[0]))
+    if "delta" in base:
+        raise ChunkCorrupt(
+            f"{chunks[0]}: generation head is a delta, not a base"
+        )
+    replay.load_state_dict(base)
+    for name in chunks[1:]:
+        replay.apply_delta_state_dict(
+            read_chunk(os.path.join(directory, name))
+        )
+    return int(manifest.get("step", 0))
+
+
+class IncrementalCheckpointer:
+    """Owns one replay object's incremental checkpoint chain.
+
+    ``save(step)`` runs on the learner thread: it takes the replay's
+    base/delta snapshot (the bounded part) and hands it to the writer
+    thread; serialization, compression, IO and the manifest commit happen
+    there.  Returns False — and counts an ``inflight_skip`` — when the
+    previous save is still being written (backpressure; the next delta
+    covers the wider span).  ``sync=True`` writes inline on the caller
+    (deterministic tests, final-save-at-exit callers).
+    """
+
+    def __init__(self, root: str, replay, suffix: str = "",
+                 base_every: int = 16, compress: bool = False,
+                 sync: bool = False):
+        self._dir = inc_dir(root, suffix)
+        os.makedirs(self._dir, exist_ok=True)
+        self._replay = replay
+        self._base_every = max(1, int(base_every))
+        self._compress = bool(compress)
+        self._sync = bool(sync)
+        # Chain continuation: adopt the committed manifest's position.  The
+        # first save() chains onto it only if the replay's own counters
+        # still match its chain_mark (i.e. the replay was restored from
+        # this very chain); any mismatch forces a fresh-generation base.
+        self._manifest = read_manifest(self._dir)
+        self.error: Optional[BaseException] = None
+        # Stats (learner-thread reads; writer-thread increments are
+        # int-assignments under the cv).
+        self._stall_ms_total = 0.0
+        self._last_stall_ms = 0.0
+        self._saves = 0
+        self._bases = 0
+        self._deltas = 0
+        self._inflight_skips = 0
+        self._bytes_written = 0
+        self._last_chunk_bytes = 0
+        self._write_ms_total = 0.0
+        self._job = None  # (arrays, step, is_base) awaiting the writer
+        self._busy = False
+        self._stop = False
+        self._cv = threading.Condition()
+        self._thread = None
+        if not self._sync:
+            self._thread = threading.Thread(
+                target=self._loop, name="ckpt-writer", daemon=True
+            )
+            self._thread.start()
+
+    # -- learner side ------------------------------------------------------
+
+    def save(self, step: int, force_base: bool = False) -> bool:
+        """Snapshot + enqueue one base/delta.  Learner-visible stall is
+        exactly the time spent in this call."""
+        if self.error is not None:
+            raise RuntimeError("checkpoint writer failed") from self.error
+        t0 = time.perf_counter()
+        with self._cv:
+            if self._busy or self._job is not None:
+                self._inflight_skips += 1
+                return False
+        # base_every counts DELTAS between full bases (a generation holds
+        # 1 base + base_every deltas before the next base bounds the chain).
+        base_due = (
+            force_base
+            or self._manifest is None
+            or len(self._manifest["chunks"]) > self._base_every
+        )
+        arrays = self._snapshot(base_due)
+        is_base = "delta" not in arrays
+        if not is_base and not self._chains_onto_manifest(arrays):
+            # The live replay does not continue the committed chain (a
+            # fresh run over a stale dir) — restart with a base.
+            arrays = self._snapshot(True)
+            is_base = True
+        if self._sync:
+            self._write(arrays, int(step), is_base)
+            if self.error is not None:
+                raise RuntimeError("checkpoint writer failed") from self.error
+        else:
+            with self._cv:
+                self._job = (arrays, int(step), is_base)
+                self._cv.notify()
+        stall = (time.perf_counter() - t0) * 1e3
+        self._last_stall_ms = stall
+        self._stall_ms_total += stall
+        self._saves += 1
+        return True
+
+    def _snapshot(self, force_base: bool) -> dict:
+        if hasattr(self._replay, "delta_state_dict"):
+            return self._replay.delta_state_dict(force_base=force_base)
+        # Degraded path (no delta protocol): full snapshot every save —
+        # still async on the IO side.
+        return dict(self._replay.state_dict())
+
+    def _chains_onto_manifest(self, delta: dict) -> bool:
+        if self._manifest is None:
+            return False
+        mark = self._manifest.get("chain_mark")
+        if mark is None:
+            return False
+        prev = np.asarray(delta["chain_prev"]).reshape(-1)
+        return prev.tolist() == list(mark)
+
+    def flush(self, timeout: float = 600.0) -> bool:
+        """Block until the writer has drained; False on timeout (the caller
+        must surface it — an unwritten final save is silent data loss)."""
+        if self._sync:
+            return True
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while (self._job is not None or self._busy) \
+                    and time.monotonic() < deadline:
+                self._cv.wait(timeout=0.1)
+            done = self._job is None and not self._busy
+        if self.error is not None:
+            raise RuntimeError("checkpoint writer failed") from self.error
+        return done
+
+    def close(self, timeout: float = 600.0) -> None:
+        if self._sync:
+            return
+        self.flush(timeout)
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=30.0)
+
+    def stats(self) -> dict:
+        return {
+            "saves": self._saves,
+            "bases": self._bases,
+            "deltas": self._deltas,
+            "inflight_skips": self._inflight_skips,
+            "bytes_written": self._bytes_written,
+            "last_chunk_bytes": self._last_chunk_bytes,
+            "last_stall_ms": round(self._last_stall_ms, 3),
+            "stall_ms_total": round(self._stall_ms_total, 3),
+            "write_ms_total": round(self._write_ms_total, 3),
+        }
+
+    # -- writer side -------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._job is None and not self._stop:
+                    self._cv.wait()
+                if self._job is None and self._stop:
+                    return
+                job, self._job = self._job, None
+                self._busy = True
+            try:
+                self._write(*job)
+            except BaseException as e:  # noqa: BLE001 — surfaced at next save
+                self.error = e
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _write(self, arrays: dict, step: int, is_base: bool) -> None:
+        t0 = time.perf_counter()
+        # Materialize lazy leaves HERE (np.asarray on a jax Array is the
+        # device_get — the expensive transfer the learner thread skipped).
+        arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        if is_base:
+            gen = (0 if self._manifest is None
+                   else int(self._manifest["generation"]) + 1)
+            idx, chunks = 0, []
+        else:
+            gen = int(self._manifest["generation"])
+            chunks = list(self._manifest["chunks"])
+            idx = len(chunks)
+        name = _chunk_name(gen, idx)
+        nbytes = write_chunk(os.path.join(self._dir, name), arrays,
+                             compress=self._compress)
+        chunks.append(name)
+        mark = arrays.get("chain_mark")  # absent on degraded (no-delta) replays
+        manifest = {
+            "version": 1,
+            "generation": gen,
+            "chunks": chunks,
+            "step": int(step),
+            "chain_mark": (np.asarray(mark).reshape(-1).tolist()
+                           if mark is not None else None),
+            "bytes": nbytes,
+        }
+        _write_manifest(self._dir, manifest)  # the commit
+        self._manifest = manifest
+        if is_base:
+            self._prune(gen)
+            self._bases += 1
+        else:
+            self._deltas += 1
+        self._bytes_written += nbytes
+        self._last_chunk_bytes = nbytes
+        self._write_ms_total += (time.perf_counter() - t0) * 1e3
+
+
+    def _prune(self, live_gen: int) -> None:
+        """Once the manifest names generation ``live_gen``, every older
+        generation's files are unreferenced — remove them."""
+        for name in os.listdir(self._dir):
+            if not name.startswith("chunk_"):
+                continue
+            try:
+                gen = int(name.split("_")[1])
+            except (IndexError, ValueError):
+                continue
+            if gen < live_gen:
+                try:
+                    os.unlink(os.path.join(self._dir, name))
+                except OSError:
+                    pass

@@ -192,12 +192,34 @@ class TransportStats:
         }
 
 
+def emit_event(event: str, stream: Optional[IO] = None, **fields) -> dict:
+    """One structured JSONL event line, loggerless.
+
+    The escape hatch for code that must speak on the metrics stream but has
+    no ``MetricLogger`` in scope (utils/checkpoint restore paths, tools):
+    a machine-readable ``{"event": ..., ...}`` record to ``stream``
+    (stderr default — stdout belongs to the run's metric records), never a
+    bare ``print``.  Returns the record so callers can also log/assert it.
+    """
+    record = {"event": event, **fields}
+    out = stream if stream is not None else sys.stderr
+    try:
+        out.write(json.dumps(record) + "\n")
+        out.flush()
+    except ValueError:  # closed stream
+        pass
+    return record
+
+
 class MetricLogger:
     """Aggregate scalars between emits; write one JSONL record per emit.
 
     ``log(name, value)`` accumulates (mean/min/max/count per emit window);
-    ``emit(**extra)`` flushes a record.  Thread-safe; writers share one
-    logger.
+    ``emit(**extra)`` flushes a record.  ``event(name, **fields)`` writes an
+    out-of-band JSONL record immediately WITHOUT draining the scalar
+    accumulators (discrete occurrences — a missing replay leg on restore, a
+    salvage — are events, not window statistics).  Thread-safe; writers
+    share one logger.
     """
 
     def __init__(self, stream: Optional[IO] = None, path: Optional[str] = None,
@@ -229,6 +251,20 @@ class MetricLogger:
     def log(self, name: str, value: float) -> None:
         with self._lock:
             self._acc[name].append(float(value))
+
+    def event(self, name: str, **fields) -> dict:
+        """Immediate structured event record on every stream (see class
+        docstring) — accumulators are untouched."""
+        record = {"event": name, **fields}
+        line = json.dumps(record)
+        with self._lock:
+            for s in self._streams:
+                try:
+                    s.write(line + "\n")
+                    s.flush()
+                except ValueError:  # closed stream
+                    pass
+        return record
 
     def emit(self, **extra) -> dict:
         with self._lock:

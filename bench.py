@@ -494,6 +494,140 @@ def _host_dedup_bench(capacity: int = 2_000_000, iters: int = 2000,
     }
 
 
+def _checkpoint_stall_bench(capacity: int = 2_000_000,
+                            interval_rows: int = 65_536,
+                            deltas: int = 3,
+                            workdir: str | None = None) -> dict:
+    """Learner-visible checkpoint stall: synchronous full-write vs the
+    incremental async subsystem (utils/checkpoint_inc), at the 2M-slot host
+    DEDUP layout (config3's ~17.6 GB frame ring, PROFILE.md round 5 — the
+    buffer whose inline np.savez was minutes of learner dead air).
+
+    Host-only (native C++ dedup core, no jax).  Two measurements:
+      * ``full_sync``: one inline full snapshot+write on the caller thread
+        — the status-quo save_checkpoint replay leg, same wire format.
+      * ``incremental``: async saves at a fixed ingest interval; the
+        learner-visible stall is just ``save()`` (dirty-span memcpy +
+        enqueue), the write lands on the writer thread.  A half-interval
+        delta shows bytes ∝ interval, not capacity.
+    """
+    import shutil
+    import tempfile
+
+    from ape_x_dqn_tpu.replay.native_dedup import (
+        NativeDedupReplay,
+        native_dedup_available,
+        native_dedup_error,
+    )
+    from ape_x_dqn_tpu.types import DedupChunk
+    from ape_x_dqn_tpu.utils.checkpoint_inc import IncrementalCheckpointer
+
+    if not native_dedup_available():
+        return {"skipped": f"native core unavailable: {native_dedup_error()}"}
+    rng = np.random.default_rng(0)
+    obs_shape = (84, 84, 1)
+    rep = NativeDedupReplay(capacity, obs_shape, frame_ratio=1.25)
+    M = 4096  # transitions per chunk over M+1 fresh frames (dedup stream)
+    frames = rng.integers(0, 255, (M + 1, *obs_shape), dtype=np.uint8)
+    chunk_proto = dict(
+        obs_ref=np.arange(M, dtype=np.int32),
+        next_ref=np.arange(1, M + 1, dtype=np.int32),
+        action=rng.integers(0, 4, M).astype(np.int32),
+        reward=rng.normal(size=M).astype(np.float32),
+        discount=np.full(M, 0.97, np.float32),
+        prev_frames=M + 1,
+    )
+    prio = (np.abs(rng.normal(size=M)) + 0.1).astype(np.float32)
+    seq = 0
+
+    def ingest(rows: int) -> None:
+        nonlocal seq
+        for _ in range(max(1, rows // M)):
+            rep.add(prio, DedupChunk(frames=frames, source=1, chunk_seq=seq,
+                                     **chunk_proto))
+            seq += 1
+
+    def churn(iters: int = 32) -> None:
+        # Learner-shaped priority restamps between checkpoints — the
+        # sparse half of a delta.
+        srng = np.random.default_rng(seq)
+        for _ in range(iters):
+            batch = rep.sample(32, rng=srng)
+            rep.update_priorities(
+                batch.indices, np.abs(srng.normal(size=32)) + 0.1
+            )
+
+    ingest(capacity // 2)  # half occupancy, like host_dedup_2m
+    root = tempfile.mkdtemp(prefix="ckpt_stall_", dir=workdir)
+    try:
+        # -- synchronous full write (the path being replaced) -------------
+        full = IncrementalCheckpointer(os.path.join(root, "full"), rep,
+                                       sync=True)
+        t0 = time.perf_counter()
+        full.save(0, force_base=True)
+        full_stall_ms = (time.perf_counter() - t0) * 1e3
+        full_bytes = full.stats()["last_chunk_bytes"]
+        shutil.rmtree(os.path.join(root, "full"))  # reclaim before leg 2
+
+        # -- incremental async -------------------------------------------
+        ck = IncrementalCheckpointer(os.path.join(root, "inc"), rep,
+                                     base_every=64)
+        ck.save(0)        # generation base (async, amortized over the run)
+        ck.flush()
+        base_bytes = ck.stats()["last_chunk_bytes"]
+        stalls, delta_bytes = [], []
+        for k in range(deltas):
+            ingest(interval_rows)
+            churn()
+            t0 = time.perf_counter()
+            assert ck.save(k + 1)
+            stalls.append((time.perf_counter() - t0) * 1e3)
+            ck.flush()  # outside the stall: the writer's time, not the
+            #             learner's (flush here only so last_chunk_bytes
+            #             and the next save's backpressure are exact)
+            delta_bytes.append(ck.stats()["last_chunk_bytes"])
+        ingest(interval_rows // 2)
+        churn()
+        t0 = time.perf_counter()
+        assert ck.save(deltas + 1)
+        half_stall_ms = (time.perf_counter() - t0) * 1e3
+        ck.flush()
+        half_bytes = ck.stats()["last_chunk_bytes"]
+        ck.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    mean_stall = sum(stalls) / len(stalls)
+    mean_bytes = sum(delta_bytes) / len(delta_bytes)
+    return {
+        "capacity": capacity,
+        "occupancy": rep.size(),
+        "frames_gb": round(rep.frames_nbytes() / 1e9, 2),
+        "interval_rows": interval_rows,
+        "full_sync": {
+            "stall_ms": round(full_stall_ms, 1),
+            "bytes": int(full_bytes),
+        },
+        "incremental": {
+            "base_bytes": int(base_bytes),
+            "delta_stall_ms": [round(s, 1) for s in stalls],
+            "delta_stall_ms_mean": round(mean_stall, 1),
+            "delta_bytes": [int(b) for b in delta_bytes],
+            "half_interval_stall_ms": round(half_stall_ms, 1),
+            "half_interval_bytes": int(half_bytes),
+        },
+        "stall_reduction_x": round(full_stall_ms / max(mean_stall, 1e-3), 1),
+        "delta_vs_full_bytes_x": round(full_bytes / max(mean_bytes, 1), 1),
+        "half_over_full_interval_bytes": round(half_bytes / mean_bytes, 3),
+        "note": (
+            "learner-visible stall = time inside save(); the incremental "
+            "save's IO happens on the writer thread.  half_over_full_"
+            "interval_bytes ~ 0.5 demonstrates delta bytes proportional "
+            "to the checkpoint interval, not the ring capacity"
+        ),
+    }
+
+
 def _dedup_fused_bench(args, jnp, jax) -> dict:
     """Single-chip fused learner on the DEDUP HBM ring at the headline
     workload — the per-step cost of the ref indirection vs the
@@ -735,6 +869,20 @@ def main() -> None:
     parser.add_argument("--serving-network", default="conv",
                         choices=("conv", "nature", "mlp"))
     parser.add_argument("--serving-max-batch", type=int, default=32)
+    parser.add_argument("--skip-ckpt-stall", action="store_true",
+                        help="skip the checkpoint_stall section (2M-slot "
+                        "native dedup ring: ~17.6 GB RAM + a one-off "
+                        "multi-GB full-snapshot disk write)")
+    parser.add_argument("--ckpt-capacity", type=int, default=2_000_000,
+                        help="slots for the checkpoint_stall dedup layout")
+    parser.add_argument("--ckpt-interval-rows", type=int, default=65_536,
+                        help="transitions ingested between incremental "
+                        "saves (the checkpoint interval the delta covers)")
+    parser.add_argument(
+        "--ckpt-stall-only", action="store_true",
+        help="run ONLY the checkpoint_stall section and print its JSON "
+        "(artifact generation: demos/ckpt_stall.json)",
+    )
     parser.add_argument("--skip-xp-transport", action="store_true",
                         help="skip the shm-ring vs mp.Queue transport bench")
     parser.add_argument("--xp-workers", default="4,16,64",
@@ -749,6 +897,13 @@ def main() -> None:
         "transport can't reach the driver unseen",
     )
     args = parser.parse_args()
+
+    if args.ckpt_stall_only:
+        print(json.dumps({"checkpoint_stall": _checkpoint_stall_bench(
+            capacity=args.ckpt_capacity,
+            interval_rows=args.ckpt_interval_rows,
+        )}))
+        return
 
     if args.xp_transport_smoke:
         out = _xp_transport_bench(workers=(2,), seconds=0.5, rows=16,
@@ -834,6 +989,12 @@ def main() -> None:
         section("xp_transport", _xp_transport_bench,
                 workers=tuple(int(w) for w in args.xp_workers.split(",")),
                 seconds=args.xp_seconds)
+    if not args.skip_ckpt_stall:
+        # Host-only: learner-visible checkpoint stall, full-sync vs the
+        # incremental async subsystem, at the 2M-slot dedup layout.
+        section("checkpoint_stall", _checkpoint_stall_bench,
+                capacity=args.ckpt_capacity,
+                interval_rows=args.ckpt_interval_rows)
     if on_chip and not args.skip_pipeline:
         section("actor_solo", _actor_solo_bench)
         extra["pipeline"] = _median_pipeline(

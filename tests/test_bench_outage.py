@@ -31,6 +31,8 @@ def test_bench_emits_host_only_json_during_outage():
         "--serving-max-batch", "8",
         "--xp-workers", "2",                # tiny: mechanism, not scale
         "--xp-seconds", "0.5",
+        "--ckpt-capacity", "8192",          # tiny: mechanism, not scale
+        "--ckpt-interval-rows", "4096",
     ]
     proc = subprocess.run(
         cmd, capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
@@ -45,9 +47,13 @@ def test_bench_emits_host_only_json_during_outage():
     assert rec["backend_probe"]["error"]
     # Host-only sections survive the outage...
     for key in ("host_replay_2m", "host_dedup_2m", "serving_qps",
-                "xp_transport"):
+                "xp_transport", "checkpoint_stall"):
         assert key in rec, f"missing host-only section {key}"
     assert rec["host_replay_2m"].get("sample_update_pairs_per_sec", 0) > 0
+    cs = rec["checkpoint_stall"]
+    if "skipped" not in cs:  # native core present on this machine
+        assert "error" not in cs, cs
+        assert cs["stall_reduction_x"] > 1.0
     # ...including the serving bench, which pins its child to CPU.
     sq = rec["serving_qps"]
     assert "error" not in sq, sq

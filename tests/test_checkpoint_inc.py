@@ -1,0 +1,639 @@
+"""Incremental async replay checkpointing (utils/checkpoint_inc).
+
+The properties the subsystem sells, adversarially:
+
+  * chunk files are CRC-framed — truncation/bit-rot is detected, never
+    half-applied;
+  * the manifest is the atomic commit marker, written LAST — a SIGKILL
+    barrage against a live writer always leaves a restorable chain, with
+    uncommitted tails ignored;
+  * replaying base + deltas is BIT-FOR-BIT equal to a full snapshot, for
+    every replay implementation (PrioritizedReplay raw/compressed,
+    DedupReplay, NativeDedupReplay, FusedDedupLearner dp=1 and dp>1);
+  * dp>1 sharded-dedup kill/resume (the ROADMAP item): per-shard cursors,
+    dropped_carry and frame_dead accounting survive, training continues;
+  * the async writer applies backpressure (inflight skips) and surfaces
+    its failures at the next save instead of dying silently.
+"""
+
+import json
+import multiprocessing
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.replay import PrioritizedReplay
+from ape_x_dqn_tpu.replay.dedup import DedupReplay
+from ape_x_dqn_tpu.types import DedupChunk, NStepTransition
+from ape_x_dqn_tpu.utils import checkpoint_inc as ci
+from ape_x_dqn_tpu.utils.checkpoint_inc import (
+    ChunkCorrupt,
+    IncrementalCheckpointer,
+    load_incremental_replay,
+    read_chunk,
+    read_manifest,
+    write_chunk,
+)
+
+OBS = (6, 6, 1)
+
+
+def np_chunk(M=8, seed=0):
+    r = np.random.default_rng(seed)
+    return NStepTransition(
+        obs=r.integers(0, 255, (M, *OBS), dtype=np.uint8),
+        action=r.integers(0, 3, (M,), dtype=np.int32),
+        reward=r.normal(size=(M,)).astype(np.float32),
+        discount=np.full((M,), 0.9, np.float32),
+        next_obs=r.integers(0, 255, (M, *OBS), dtype=np.uint8),
+    )
+
+
+def dchunk(M=8, src=1, seq=0, seed=0, carry=0, obs=OBS):
+    """One dedup chunk; ``carry`` > 0 makes the first rows reference the
+    previous chunk's frames (negative refs — dropped on a seq gap)."""
+    r = np.random.default_rng(seed)
+    obs_ref = np.arange(M, dtype=np.int32)
+    obs_ref[:carry] = -np.arange(1, carry + 1, dtype=np.int32)
+    return DedupChunk(
+        frames=r.integers(0, 255, (M + 1, *obs), dtype=np.uint8),
+        obs_ref=obs_ref,
+        next_ref=np.arange(1, M + 1, dtype=np.int32),
+        action=r.integers(0, 3, M).astype(np.int32),
+        reward=r.normal(size=M).astype(np.float32),
+        discount=np.full(M, 0.9, np.float32),
+        source=src,
+        chunk_seq=seq,
+        prev_frames=M + 1,
+    )
+
+
+def prio(M=8, seed=0):
+    r = np.random.default_rng(seed + 1000)
+    return (np.abs(r.normal(size=M)) + 0.1).astype(np.float32)
+
+
+def assert_same_state(s1: dict, s2: dict):
+    assert set(s1) == set(s2), (set(s1) ^ set(s2))
+    for k in s1:
+        np.testing.assert_array_equal(
+            np.asarray(s1[k]), np.asarray(s2[k]), err_msg=k
+        )
+
+
+def churn(rep, seed=0, iters=4, B=4):
+    """Sample + restamp — dirties sparse priorities between saves."""
+    r = np.random.default_rng(seed)
+    for _ in range(iters):
+        batch = rep.sample(B, rng=r)
+        rep.update_priorities(
+            batch.indices, (np.abs(r.normal(size=B)) + 0.1).astype(np.float32)
+        )
+
+
+class TestChunkFormat:
+    def test_roundtrip_preserves_dtypes_and_values(self, tmp_path):
+        arrays = {
+            "a": np.arange(7, dtype=np.int64),
+            "b": np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32),
+            "c": np.asarray(True),
+            "d": np.zeros((0,), np.float64),
+        }
+        p = str(tmp_path / "c.ckpt")
+        n = write_chunk(p, arrays)
+        assert n == os.path.getsize(p)
+        got = read_chunk(p)
+        assert set(got) == set(arrays)
+        for k in arrays:
+            assert got[k].dtype == np.asarray(arrays[k]).dtype, k
+            np.testing.assert_array_equal(got[k], arrays[k])
+
+    def test_zlib_flag_roundtrip(self, tmp_path):
+        arrays = {"x": np.zeros((1000,), np.int64)}  # compressible
+        raw = str(tmp_path / "raw.ckpt")
+        comp = str(tmp_path / "comp.ckpt")
+        n_raw = write_chunk(raw, arrays)
+        n_comp = write_chunk(comp, arrays, compress=True)
+        assert n_comp < n_raw
+        np.testing.assert_array_equal(read_chunk(comp)["x"], arrays["x"])
+
+    def test_truncated_chunk_rejected(self, tmp_path):
+        p = str(tmp_path / "c.ckpt")
+        write_chunk(p, {"x": np.arange(100)})
+        data = open(p, "rb").read()
+        with open(p, "wb") as f:  # the SIGKILL-mid-write shape: a torn tail
+            f.write(data[: len(data) - 7])
+        with pytest.raises(ChunkCorrupt):
+            read_chunk(p)
+
+    def test_bitflip_fails_crc(self, tmp_path):
+        p = str(tmp_path / "c.ckpt")
+        write_chunk(p, {"x": np.arange(100)})
+        data = bytearray(open(p, "rb").read())
+        data[len(data) // 2] ^= 0x40
+        open(p, "wb").write(bytes(data))
+        with pytest.raises(ChunkCorrupt, match="crc"):
+            read_chunk(p)
+
+    def test_bad_magic_rejected(self, tmp_path):
+        p = str(tmp_path / "c.ckpt")
+        with open(p, "wb") as f:
+            f.write(b"NOPE" + b"\0" * 64)
+        with pytest.raises(ChunkCorrupt, match="magic"):
+            read_chunk(p)
+
+
+class TestManifestCommit:
+    def _chain(self, tmp_path, saves=3):
+        rep = PrioritizedReplay(256, OBS)
+        ck = IncrementalCheckpointer(str(tmp_path), rep, sync=True)
+        for k in range(saves):
+            rep.add(prio(seed=k), np_chunk(seed=k))
+            churn(rep, seed=k)
+            ck.save(k + 1)
+        return rep
+
+    def test_uncommitted_tail_and_tmp_files_ignored(self, tmp_path):
+        rep = self._chain(tmp_path)
+        d = ci.inc_dir(str(tmp_path))
+        manifest = read_manifest(d)
+        # A killed writer's leavings: a torn chunk file beyond the manifest
+        # and a half-written manifest tmp — neither is referenced.
+        with open(os.path.join(d, "chunk_0_99.ckpt"), "wb") as f:
+            f.write(b"APXC" + b"\x01\0\0\0garbage")
+        with open(os.path.join(d, "MANIFEST.json.tmp"), "w") as f:
+            f.write('{"truncat')
+        rep2 = PrioritizedReplay(256, OBS)
+        assert load_incremental_replay(str(tmp_path), rep2) == 3
+        assert_same_state(rep.state_dict(), rep2.state_dict())
+
+    def test_corrupt_referenced_chunk_raises(self, tmp_path):
+        self._chain(tmp_path)
+        d = ci.inc_dir(str(tmp_path))
+        name = read_manifest(d)["chunks"][-1]
+        data = bytearray(open(os.path.join(d, name), "rb").read())
+        data[-1] ^= 0x01
+        open(os.path.join(d, name), "wb").write(bytes(data))
+        with pytest.raises(ChunkCorrupt):
+            load_incremental_replay(str(tmp_path), PrioritizedReplay(256, OBS))
+
+    def test_no_manifest_means_no_chain(self, tmp_path):
+        assert load_incremental_replay(
+            str(tmp_path), PrioritizedReplay(256, OBS)
+        ) is None
+        os.makedirs(ci.inc_dir(str(tmp_path)))
+        # chunks without a manifest (killed before the first commit)
+        write_chunk(os.path.join(ci.inc_dir(str(tmp_path)), "chunk_0_0.ckpt"),
+                    {"x": np.arange(3)})
+        assert load_incremental_replay(
+            str(tmp_path), PrioritizedReplay(256, OBS)
+        ) is None
+
+
+def _kill_victim(root: str) -> None:
+    """Barrage child: add/churn/save as fast as possible until SIGKILLed."""
+    rep = PrioritizedReplay(512, OBS)
+    ck = IncrementalCheckpointer(root, rep, sync=True, base_every=3)
+    step = 0
+    while True:
+        rep.add(prio(seed=step), np_chunk(seed=step))
+        if step % 2:
+            churn(rep, seed=step)
+        step += 1
+        ck.save(step)
+
+
+class TestSigkillBarrage:
+    def test_kill_mid_write_always_restores_last_manifest(self, tmp_path):
+        """tests/test_shm_ring.py's kill-barrage style against the writer:
+        children SIGKILLed at random moments mid-chain; every survivor dir
+        must restore from its newest committed manifest, with the restored
+        counters matching the manifest's chain_mark exactly."""
+        ctx = multiprocessing.get_context("fork")
+        rng = np.random.default_rng(0)
+        for round_i in range(3):
+            root = str(tmp_path / f"r{round_i}")
+            proc = ctx.Process(target=_kill_victim, args=(root,), daemon=True)
+            proc.start()
+            try:
+                deadline = time.monotonic() + 60.0
+                while read_manifest(ci.inc_dir(root)) is None:
+                    assert proc.is_alive(), "victim died on its own"
+                    assert time.monotonic() < deadline, "no commit within 60s"
+                    time.sleep(0.01)
+                time.sleep(float(rng.uniform(0.02, 0.25)))
+            finally:
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.join(10.0)
+            manifest = read_manifest(ci.inc_dir(root))
+            rep = PrioritizedReplay(512, OBS)
+            step = load_incremental_replay(root, rep)
+            assert step == manifest["step"]
+            state = rep.state_dict()
+            assert [int(state["count"])] == manifest["chain_mark"]
+            assert int(state["count"]) >= 8  # at least the first save's rows
+
+
+class TestDeltaChainEqualsFull:
+    @pytest.mark.parametrize("compressed", [False, True])
+    def test_prioritized_replay(self, tmp_path, compressed):
+        rep = PrioritizedReplay(64, OBS, frame_compression=compressed)
+        ck = IncrementalCheckpointer(str(tmp_path), rep, sync=True)
+        for k in range(5):  # wraps the 64-slot ring (5 × 16 rows)
+            rep.add(prio(16, seed=k), np_chunk(16, seed=k))
+            churn(rep, seed=k)
+            ck.save(k + 1)
+        stats = ck.stats()
+        assert stats["bases"] == 1 and stats["deltas"] == 4
+        rep2 = PrioritizedReplay(64, OBS, frame_compression=compressed)
+        assert load_incremental_replay(str(tmp_path), rep2) == 5
+        assert_same_state(rep.state_dict(), rep2.state_dict())
+        # The restored replay keeps the chain alive: another delta applies.
+        rep.add(prio(16, seed=9), np_chunk(16, seed=9))
+        rep2.apply_delta_state_dict(rep.delta_state_dict())
+        assert_same_state(rep.state_dict(), rep2.state_dict())
+
+    def test_delta_bytes_track_interval_not_capacity(self, tmp_path):
+        rep = PrioritizedReplay(4096, OBS)
+        ck = IncrementalCheckpointer(str(tmp_path), rep, sync=True)
+        for k in range(16):  # 1024 occupied rows — the base's footprint
+            rep.add(prio(64, seed=100 + k), np_chunk(64, seed=100 + k))
+        ck.save(1)
+        base_bytes = ck.stats()["last_chunk_bytes"]
+        rep.add(prio(64, seed=1), np_chunk(64, seed=1))
+        ck.save(2)
+        delta_one = ck.stats()["last_chunk_bytes"]
+        for k in range(2, 4):
+            rep.add(prio(64, seed=k), np_chunk(64, seed=k))
+        ck.save(3)
+        delta_two = ck.stats()["last_chunk_bytes"]
+        assert delta_one < base_bytes
+        # 2x the written span ⇒ ~2x the delta bytes (framing epsilon).
+        assert 1.7 < delta_two / delta_one < 2.3
+
+    def test_dedup_replay_with_sweep_and_carry_accounting(self, tmp_path):
+        rep = DedupReplay(64, OBS, frame_ratio=1.25)
+        ck = IncrementalCheckpointer(str(tmp_path), rep, sync=True)
+        seq = {1: 0, 2: 0}
+        k = 0
+
+        def feed(src, gap=False):
+            nonlocal k
+            if gap:
+                seq[src] += 2  # skip one chunk_seq → carry rows drop
+            rep.add(prio(seed=k),
+                    dchunk(src=src, seq=seq[src], seed=k, carry=2))
+            seq[src] += 1
+            k += 1
+
+        feed(1)
+        feed(2)
+        ck.save(1)
+        # Enough frames to wrap the 80-slot frame ring → liveness sweep
+        # kills old rows (frame_dead), plus one deliberate carry gap.
+        for i in range(6):
+            feed(1, gap=(i == 2))
+            feed(2)
+            churn(rep, seed=i, B=2)
+            ck.save(2 + i)
+        state = rep.state_dict()
+        assert int(state["frame_dead"]) > 0
+        assert int(state["dropped_carry"]) > 0
+        rep2 = DedupReplay(64, OBS, frame_ratio=1.25)
+        assert load_incremental_replay(str(tmp_path), rep2) == 7
+        assert_same_state(state, rep2.state_dict())
+        assert rep2._resolver.dropped_carry == rep._resolver.dropped_carry
+        assert rep2._frame_dead == rep._frame_dead
+
+    def test_native_dedup_bit_for_bit_and_cross_impl(self, tmp_path):
+        from ape_x_dqn_tpu.replay.native_dedup import (
+            NativeDedupReplay,
+            native_dedup_available,
+            native_dedup_error,
+        )
+
+        if not native_dedup_available():
+            pytest.skip(f"native core unavailable: {native_dedup_error()}")
+        rep = NativeDedupReplay(64, OBS, frame_ratio=1.25)
+        ck = IncrementalCheckpointer(str(tmp_path), rep, sync=True)
+        # Two interleaved sources (the shape that strands live transitions
+        # past their frames — per-source spans interleave in the shared
+        # ring, so one source's sweep catches the other's rows), same feed
+        # as test_dedup_replay_with_sweep_and_carry_accounting.
+        seq = {1: 0, 2: 0}
+        k = 0
+
+        def feed(src, gap=False):
+            nonlocal k
+            if gap:
+                seq[src] += 2
+            rep.add(prio(seed=k),
+                    dchunk(src=src, seq=seq[src], seed=k, carry=2))
+            seq[src] += 1
+            k += 1
+
+        feed(1)
+        feed(2)
+        ck.save(1)
+        for i in range(6):
+            feed(1, gap=(i == 2))
+            feed(2)
+            churn(rep, seed=i, B=2)
+            ck.save(2 + i)
+        state = rep.state_dict()
+        assert int(state["frame_dead"]) > 0
+        assert int(state["dropped_carry"]) > 0
+        # Same chain, restored into BOTH implementations — the numpy twin
+        # stays the native core's oracle through checkpointing.
+        rep_native = NativeDedupReplay(64, OBS, frame_ratio=1.25)
+        assert load_incremental_replay(str(tmp_path), rep_native) == 7
+        assert_same_state(state, rep_native.state_dict())
+        rep_py = DedupReplay(64, OBS, frame_ratio=1.25)
+        assert load_incremental_replay(str(tmp_path), rep_py) == 7
+        assert_same_state(state, rep_py.state_dict())
+
+    def test_chain_discontinuity_raises(self, tmp_path):
+        rep = PrioritizedReplay(64, OBS)
+        rep.add(prio(seed=0), np_chunk(seed=0))
+        rep.delta_state_dict()  # mark
+        rep.add(prio(seed=1), np_chunk(seed=1))
+        delta = rep.delta_state_dict()
+        other = PrioritizedReplay(64, OBS)
+        other.add(prio(16, seed=7), np_chunk(16, seed=7))  # count 16 != 8
+        with pytest.raises(ValueError, match="discontinuity"):
+            other.apply_delta_state_dict(delta)
+        with pytest.raises(ValueError, match="delta"):
+            other.apply_delta_state_dict(other.state_dict())
+
+
+class _SlowLeaf:
+    """np.asarray(…) on the writer thread blocks — deterministic way to
+    hold the writer busy and exercise backpressure."""
+
+    def __init__(self, hold: float):
+        self._hold = hold
+
+    def __array__(self, dtype=None, copy=None):
+        time.sleep(self._hold)
+        return np.zeros((4,), np.float32)
+
+
+class _DegradedReplay:
+    """state_dict/load_state_dict only — no delta protocol."""
+
+    def __init__(self, hold: float = 0.0):
+        self.hold = hold
+        self.loaded = None
+
+    def state_dict(self):
+        leaf = _SlowLeaf(self.hold) if self.hold else np.arange(4.0)
+        return {"x": leaf, "count": np.asarray([3], np.int64)}
+
+    def load_state_dict(self, state):
+        self.loaded = state
+
+
+class TestAsyncWriter:
+    def test_backpressure_counts_inflight_skips(self, tmp_path):
+        rep = _DegradedReplay(hold=0.4)
+        ck = IncrementalCheckpointer(str(tmp_path), rep)
+        try:
+            assert ck.save(1)           # writer now busy for ~0.4 s
+            assert not ck.save(2)       # refused, not queued behind
+            assert ck.stats()["inflight_skips"] == 1
+            assert ck.flush(timeout=30.0)
+            assert ck.save(3)           # drained — accepted again
+            assert ck.flush(timeout=30.0)
+            # Degraded replays (no delta protocol) write a full base every
+            # save, still committed manifest-last.
+            assert ck.stats()["bases"] == 2
+            m = read_manifest(ci.inc_dir(str(tmp_path)))
+            assert m["step"] == 3 and len(m["chunks"]) == 1
+        finally:
+            ck.close()
+
+    def test_writer_failure_surfaces_at_next_save(self, tmp_path):
+        class Exploding:
+            def __array__(self, dtype=None, copy=None):
+                raise RuntimeError("disk on fire")
+
+        class BadReplay:
+            def state_dict(self):
+                return {"x": Exploding()}
+
+        ck = IncrementalCheckpointer(str(tmp_path), BadReplay())
+        try:
+            ck.save(1)
+            ck.flush(timeout=30.0)
+            pytest.fail("flush must re-raise the writer failure")
+        except RuntimeError as e:
+            assert "checkpoint writer failed" in str(e)
+        finally:
+            ck.close(timeout=1.0) if ck.error is None else None
+        with pytest.raises(RuntimeError, match="checkpoint writer failed"):
+            ck.save(2)
+
+    def test_degraded_roundtrip(self, tmp_path):
+        src = _DegradedReplay()
+        ck = IncrementalCheckpointer(str(tmp_path), src, sync=True)
+        ck.save(5)
+        dst = _DegradedReplay()
+        assert load_incremental_replay(str(tmp_path), dst) == 5
+        np.testing.assert_array_equal(dst.loaded["x"], np.arange(4.0))
+
+
+class TestFusedDedup:
+    def _make(self, mesh=None, n=1):
+        import jax
+        import jax.numpy as jnp
+
+        from ape_x_dqn_tpu.learner.train_step import (
+            init_train_state,
+            make_optimizer,
+        )
+        from ape_x_dqn_tpu.models.dueling import DuelingMLP
+        from ape_x_dqn_tpu.runtime.fused_dedup import FusedDedupLearner
+
+        net = DuelingMLP(num_actions=3, hidden_sizes=(16,))
+        opt = make_optimizer("adam", learning_rate=1e-3)
+        state = init_train_state(
+            net, opt, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.uint8)
+        )
+        return FusedDedupLearner(
+            net, opt, state, (8,), capacity=64 * n, batch_size=4 * n,
+            steps_per_call=2, ingest_block=8 * n, target_sync_freq=4,
+            mesh=mesh,
+        )
+
+    def _feed(self, fused, n, seqs, gap_at=None):
+        for src in range(n):
+            seq = seqs.get(src, 0)
+            if gap_at is not None and src == gap_at:
+                seq += 2  # chunk_seq gap → carried rows drop
+            fused.add_chunk(
+                prio(seed=src * 31 + seq),
+                dchunk(src=src + 1, seq=seq, seed=src * 31 + seq,
+                       carry=2 if seq else 0, obs=(8,)),
+            )
+            seqs[src] = seq + 1
+
+    def test_single_shard_delta_equals_full(self, tmp_path):
+        fused = self._make()
+        seqs = {}
+        for _ in range(3):
+            self._feed(fused, 1, seqs)
+        fused.ingest_staged(drain=True)
+        ck = IncrementalCheckpointer(str(tmp_path), fused, sync=True)
+        ck.save(1)
+        fused.train(0.5)
+        self._feed(fused, 1, seqs)
+        fused.ingest_staged(drain=True)
+        fused.train(0.5)
+        ck.save(2)
+        assert ck.stats()["deltas"] == 1
+        fused2 = self._make()
+        assert load_incremental_replay(str(tmp_path), fused2) == 2
+        assert_same_state(fused.state_dict(), fused2.state_dict())
+        m = fused2.train(0.5)
+        assert np.isfinite(np.asarray(m.loss)).all()
+
+    def test_dp2_sharded_kill_resume_accounting(self, tmp_path):
+        """The ROADMAP dp>1 dedup-resume item, deterministically: a dp=2
+        sharded dedup learner checkpoints mid-stream (base + delta, with a
+        carry gap on one source), a fresh learner restores the chain —
+        per-shard cursors/count/fcount bit-for-bit, dropped_carry
+        accounted per resolver — and training continues monotonically."""
+        from ape_x_dqn_tpu.parallel import make_mesh
+
+        mesh = make_mesh(num_devices=2)
+        fused = self._make(mesh=mesh, n=2)
+        seqs = {}
+        for _ in range(3):
+            self._feed(fused, 2, seqs)
+        fused.ingest_staged(drain=True)
+        ck = IncrementalCheckpointer(str(tmp_path), fused, sync=True)
+        ck.save(1)
+        fused.train(0.5)
+        # Mid-stream progress, with a carry gap on shard-1's source.
+        self._feed(fused, 2, seqs, gap_at=1)
+        fused.ingest_staged(drain=True)
+        fused.train(0.5)
+        ck.save(2)
+        assert ck.stats()["deltas"] == 1
+        dropped = [r.dropped_carry for r in fused._stager.resolvers]
+        assert sum(dropped) > 0
+
+        fused2 = self._make(mesh=mesh, n=2)
+        assert load_incremental_replay(str(tmp_path), fused2) == 2
+        s1, s2 = fused.state_dict(), fused2.state_dict()
+        assert_same_state(s1, s2)
+        # Per-shard cursors restored: [n]-shaped counters, both advanced.
+        for key in ("cursor", "count", "fcount"):
+            assert np.asarray(s2[key]).shape == (2,), key
+        assert (np.asarray(s2["count"]) > 0).all()
+        assert [r.dropped_carry for r in fused2._stager.resolvers] == dropped
+        # Training continues monotonically off the restored ring.
+        step0 = fused2.step
+        m = fused2.train(0.5)
+        assert np.isfinite(np.asarray(m.loss)).all()
+        assert fused2.step == step0 + fused2.steps_per_call
+
+    def test_delta_into_wrong_shard_count_rejected(self, tmp_path):
+        fused = self._make()
+        seqs = {}
+        self._feed(fused, 1, seqs)
+        fused.ingest_staged(drain=True)
+        fused.delta_state_dict()  # mark
+        self._feed(fused, 1, seqs)
+        fused.ingest_staged(drain=True)
+        delta = fused.delta_state_dict()
+        from ape_x_dqn_tpu.parallel import make_mesh
+
+        other = self._make(mesh=make_mesh(num_devices=2), n=2)
+        with pytest.raises(ValueError, match="shard"):
+            other.apply_delta_state_dict(delta)
+
+
+class TestPipelineIntegration:
+    def test_sigkill_resume_e2e_sharded_dedup(self, tmp_path):
+        """Kill-and-resume a LIVE sharded-dedup run (device_replay + dedup
+        + data_parallel=2) off live actors: SIGKILL mid-run, resume from
+        the committed manifest, train past the restored step (the
+        acceptance shape; tools/ckpt_smoke.py --dedup-dp is the same
+        harness as a verify gate)."""
+        from tools.ckpt_smoke import run_smoke
+
+        out = run_smoke(str(tmp_path / "ckpt"), mode="dedup_dp",
+                        kill_after_chunks=2, timeout_s=240.0)
+        assert out["ok"]
+        assert out["resumed_step"] == out["committed_step"] > 0
+        assert out["continued_to_step"] > out["resumed_step"]
+        assert out["replay_size_after_resume"] > 0
+
+    def test_restore_missing_replay_emits_metrics_event(self, tmp_path,
+                                                        capsys):
+        """The degraded-restart WARNING is a structured JSONL event on the
+        metrics stream (utils/metrics.emit_event), not a bare print."""
+        import jax
+        import jax.numpy as jnp
+
+        from ape_x_dqn_tpu.learner.train_step import (
+            init_train_state,
+            make_optimizer,
+        )
+        from ape_x_dqn_tpu.models.dueling import DuelingMLP
+        from ape_x_dqn_tpu.utils.checkpoint import (
+            restore_checkpoint,
+            save_checkpoint,
+        )
+
+        net = DuelingMLP(num_actions=3, hidden_sizes=(16,))
+        state = init_train_state(
+            net, make_optimizer("adam"), jax.random.PRNGKey(0),
+            jnp.zeros((1, 8), jnp.uint8),
+        )
+        save_checkpoint(str(tmp_path), state)  # no replay leg
+        capsys.readouterr()
+        replay = PrioritizedReplay(64, OBS)
+        restore_checkpoint(str(tmp_path), state, replay=replay)
+        err = capsys.readouterr().err
+        events = [json.loads(line) for line in err.splitlines()
+                  if line.startswith("{")]
+        assert any(
+            e.get("event") == "checkpoint_restore_missing_replay"
+            for e in events
+        ), err
+
+    def test_metric_logger_event_is_out_of_band(self):
+        """MetricLogger.event: an immediate JSONL record that leaves the
+        scalar accumulators untouched (events are occurrences, not window
+        statistics)."""
+        import io
+
+        from ape_x_dqn_tpu.utils.metrics import MetricLogger
+
+        buf = io.StringIO()
+        log = MetricLogger(stream=buf)
+        log.log("a", 1.0)
+        rec = log.event("salvage", worker=3)
+        assert rec == {"event": "salvage", "worker": 3}
+        lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+        assert rec in lines                      # written immediately
+        assert log.emit()["a"] == 1.0            # accumulator survived
+
+    def test_restore_prefers_npz_then_falls_back_to_chain(self, tmp_path):
+        from ape_x_dqn_tpu.utils.checkpoint import load_replay_leg
+
+        rep = PrioritizedReplay(64, OBS)
+        rep.add(prio(seed=0), np_chunk(seed=0))
+        ck = IncrementalCheckpointer(str(tmp_path), rep, sync=True)
+        ck.save(1)
+        # No step-dir npz → the chain restores.
+        rep2 = PrioritizedReplay(64, OBS)
+        assert load_replay_leg(str(tmp_path), rep2) == "incremental"
+        assert_same_state(rep.state_dict(), rep2.state_dict())
+        assert load_replay_leg(str(tmp_path / "nope"),
+                               PrioritizedReplay(64, OBS)) is None

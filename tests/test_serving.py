@@ -278,6 +278,50 @@ class TestCheckpointSource:
         assert version == 7
         assert source.version == 7
 
+    def test_in_progress_incremental_save_never_observed(self, tmp_path):
+        """An in-flight incremental save (chunk files on disk, manifest
+        not yet rewritten; a step dir without its orbax state commit) must
+        never move latest_step or the served version — the manifest-last /
+        state-dir-last commit ordering is what CheckpointParamSource's
+        atomicity rests on (utils/checkpoint_inc)."""
+        import os
+
+        import jax
+
+        from ape_x_dqn_tpu.learner.train_step import (
+            init_train_state,
+            make_optimizer,
+        )
+        from ape_x_dqn_tpu.serving import CheckpointParamSource
+        from ape_x_dqn_tpu.utils import checkpoint_inc as ci
+        from ape_x_dqn_tpu.utils.checkpoint import save_checkpoint
+
+        net, _ = make_net_and_params()
+        state = init_train_state(
+            net, make_optimizer("adam"), jax.random.PRNGKey(0),
+            np.zeros((1, *OBS), np.uint8),
+        )
+        save_checkpoint(str(tmp_path), state)   # step 0 commits
+        source = CheckpointParamSource(str(tmp_path), state)
+        assert source.version == 0
+        # A writer mid-save: replay chunks (+ a torn manifest tmp) and a
+        # step dir whose orbax state/ marker hasn't landed yet.
+        inc = ci.inc_dir(str(tmp_path))
+        os.makedirs(inc)
+        ci.write_chunk(os.path.join(inc, "chunk_0_0.ckpt"),
+                       {"x": np.arange(8)})
+        with open(os.path.join(inc, "MANIFEST.json.tmp"), "w") as f:
+            f.write('{"half')
+        os.makedirs(str(tmp_path / "step_9"))
+        assert source.version == 0              # nothing new observed
+        assert source.get(0) is None
+        params, version = source.get(-1)        # still serves the commit
+        assert version == 0
+        # The state commit is what flips the version — and only then.
+        newer = state.replace(step=state.step + 9)
+        save_checkpoint(str(tmp_path), newer)
+        assert source.get(0)[1] == 9
+
 
 class TestLatencyHistogram:
     def test_percentiles_within_bucket_error(self):

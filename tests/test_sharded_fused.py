@@ -120,7 +120,9 @@ class TestShardedSampler:
 
             from jax.sharding import PartitionSpec as P
 
-            return jax.shard_map(
+            from ape_x_dqn_tpu.parallel.mesh import shard_map
+
+            return shard_map(
                 body, mesh=mesh, in_specs=(replay_specs(),),
                 out_specs=(P(None, "data"), P(None, "data")),
             )(st)
