@@ -201,18 +201,68 @@ class ServingConfig:
 
 
 @dataclasses.dataclass
+class ObsConfig:
+    """Fleet-wide observability knobs (ape_x_dqn_tpu/obs/).
+
+    Like ServingConfig this is new surface — the reference has no
+    observability at all, and the paper's own analysis (priority staleness,
+    age-of-experience, throughput balance) presumes exactly this layer.
+    """
+
+    # TCP port for the /metrics + /varz + /healthz exporter thread.
+    # None disables the HTTP server entirely; 0 binds an ephemeral port
+    # (the bound port is exposed as AsyncPipeline.obs_port and printed on
+    # the JSONL stream — what CI smoke gates use).
+    export_port: Optional[int] = None
+    # Probability that an actor chunk is stamped with a lineage trace id
+    # (obs/lineage.py): 0 disables tracing, 1.0 traces every chunk (tests).
+    # Sampled per CHUNK, not per transition — a chunk is one flush of a
+    # whole fleet slice, so even 0.01 yields steady span coverage.
+    trace_sample_rate: float = 0.0
+    # Flight-recorder depth: most-recent events kept in memory per process
+    # (obs/recorder.py) and mirrored into each worker's shm stats block's
+    # event ring, so they survive SIGKILL.
+    recorder_depth: int = 256
+    # /healthz marks a component degraded when its heartbeat is older than
+    # this (seconds).
+    heartbeat_stale_s: float = 15.0
+    # Where post-mortem records land (flight-recorder dumps on fault /
+    # SIGTERM; salvaged worker stats blocks after SIGKILL).  "auto" puts
+    # them under <learner.checkpoint_dir>/postmortem when checkpointing is
+    # enabled (a checkpointed run owns that directory) and disables them
+    # otherwise; an explicit path always enables; None disables.
+    postmortem_dir: Optional[str] = "auto"
+    # /varz?trace=1 on-demand jax.profiler capture (obs/trace.py): trace
+    # this many learner steps (graceful no-op where the platform's
+    # profiler can't trace — utils/profiling.trace discipline).
+    trace_steps: int = 512
+    # Trace output root; None → a fresh temp dir per capture.
+    trace_dir: Optional[str] = None
+
+
+@dataclasses.dataclass
 class ApexConfig:
     env: EnvConfig = dataclasses.field(default_factory=EnvConfig)
     actor: ActorConfig = dataclasses.field(default_factory=ActorConfig)
     learner: LearnerConfig = dataclasses.field(default_factory=LearnerConfig)
     replay: ReplayConfig = dataclasses.field(default_factory=ReplayConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     network: str = "conv"                 # "conv" | "nature" | "mlp"
     seed: int = 0
 
     def validate(self) -> "ApexConfig":
         a, l, r, s = self.actor, self.learner, self.replay, self.serving
+        o = self.obs
         checks = [
+            (o.export_port is None or 0 <= o.export_port <= 65535,
+             "obs.export_port must be None or in [0, 65535]"),
+            (0.0 <= o.trace_sample_rate <= 1.0,
+             "obs.trace_sample_rate must be in [0, 1]"),
+            (o.recorder_depth >= 1, "obs.recorder_depth must be >= 1"),
+            (o.heartbeat_stale_s > 0.0,
+             "obs.heartbeat_stale_s must be > 0"),
+            (o.trace_steps >= 1, "obs.trace_steps must be >= 1"),
             (s.max_batch >= 1, "serving.max_batch must be >= 1"),
             (s.max_wait_ms >= 0.0, "serving.max_wait_ms must be >= 0"),
             (s.queue_capacity >= s.max_batch,
@@ -358,12 +408,23 @@ def from_reference_json(data: dict) -> ApexConfig:
 _OPTIONAL_FIELDS = {
     "state_shape", "action_dim", "max_grad_norm",
     "second_moment_dtype", "target_dtype", "param_dtype",
+    "export_port", "postmortem_dir", "trace_dir",
 }
 
 
 def _coerce(current: Any, raw: str, field: str = "") -> Any:
     if raw.lower() in ("none", "null") and field in _OPTIONAL_FIELDS:
         return None
+    if current is None:
+        # Optional fields carry no type witness when unset — accept numeric
+        # spellings as numbers (obs.export_port=8080 must not become a
+        # string), anything else as the raw string (paths).
+        for conv in (int, float):
+            try:
+                return conv(raw)
+            except ValueError:
+                continue
+        return raw
     if isinstance(current, bool):
         # bool-defaulted fields may be str|bool unions (learner.restore_from:
         # False or a checkpoint path) — only coerce clearly boolean words,
@@ -420,7 +481,7 @@ def _from_native_json(data: dict) -> ApexConfig:
     sections = {
         "env": EnvConfig, "actor": ActorConfig,
         "learner": LearnerConfig, "replay": ReplayConfig,
-        "serving": ServingConfig,
+        "serving": ServingConfig, "obs": ObsConfig,
     }
     for key, value in data.items():
         if key in sections:

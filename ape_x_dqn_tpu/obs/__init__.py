@@ -1,0 +1,43 @@
+"""Fleet-wide observability: registry, exporter, lineage, flight recorder.
+
+One layer over four runtime tiers (process actors, fused learner, async
+checkpoint writer, serving) — see the module docstrings:
+
+  * ``registry``  — typed counters/gauges/histograms + providers + health
+  * ``shm_stats`` — per-worker shared-memory stats blocks (SIGKILL-readable)
+  * ``exporter``  — /metrics (Prometheus), /varz (JSON), /healthz
+  * ``lineage``   — trace-ID'd experience spans + age-of-experience
+  * ``recorder``  — flight recorder + post-mortem dumps
+  * ``trace``     — /varz?trace=1 on-demand jax.profiler capture
+
+Import-light by contract (stdlib + numpy + utils.metrics): worker
+children import ``shm_stats``/``recorder`` before jax exists.
+"""
+
+from ape_x_dqn_tpu.obs.exporter import ObsServer
+from ape_x_dqn_tpu.obs.lineage import LineageTracker
+from ape_x_dqn_tpu.obs.recorder import FlightRecorder, write_postmortem
+from ape_x_dqn_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Health,
+    Histogram,
+    MetricsRegistry,
+)
+from ape_x_dqn_tpu.obs.shm_stats import WORKER_SLOTS, WorkerStatsBlock
+from ape_x_dqn_tpu.obs.trace import TraceOnDemand
+
+__all__ = [
+    "Counter",
+    "FlightRecorder",
+    "Gauge",
+    "Health",
+    "Histogram",
+    "LineageTracker",
+    "MetricsRegistry",
+    "ObsServer",
+    "TraceOnDemand",
+    "WORKER_SLOTS",
+    "WorkerStatsBlock",
+    "write_postmortem",
+]

@@ -1,0 +1,222 @@
+"""Experience lineage tracing: follow a sampled chunk from the actor's
+flush to the train step that consumed it.
+
+The Ape-X paper's own analysis (age of experience at sample time,
+priority staleness) needs per-transition provenance the pipeline never
+had: a chunk crosses four hand-offs (actor flush → shm ring → replay
+ingest → prioritized sample → train step) and until now the only
+timestamp that survived was the transport's ``sent_t``.  This tracker
+closes the loop:
+
+  * **Trace IDs** — the actor stamps a random 63-bit id on a sampled
+    fraction of chunks (``obs.trace_sample_rate``); the id rides the wire
+    envelope (runtime/shm_ring ``_MSG``), costs 8 bytes per CHUNK (one
+    flush of a whole fleet slice), and zero when unsampled.
+  * **Spans** — ``on_ingest`` (ring drained into the replay),
+    ``on_sample`` (slot indices of a learner batch), ``on_trained``
+    (deferred priority write-back — the step's device work is done).
+    A completed trace emits one ``lineage_span`` JSONL event with
+    monotone CLOCK_MONOTONIC timestamps (comparable across processes on
+    one host — the transport's documented clock discipline).
+  * **Age of experience** — independent of sampling, every ingested
+    slot's birth time is kept (8 bytes × capacity), and every sampled
+    batch records its true ages into a log-bucketed histogram: the
+    paper's age-at-sample distribution, measured — not inferred from
+    cursor arithmetic.
+
+Host-replay path only by design: the fused HBM replay never surfaces
+sample indices to the host (that is the point of it), so lineage there
+ends at ingest.
+
+Thread-safety: ``on_ingest`` runs on the actor pump thread, ``on_sample``
+/ ``on_trained`` on the learner thread — one lock, batched calls only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ape_x_dqn_tpu.utils.metrics import LatencyHistogram
+
+# Span keys in hand-off order; monotonicity over this order is the
+# contract tests pin.
+SPAN_ORDER = ("t_act", "t_ingest", "t_first_sample", "t_trained")
+
+
+class LineageTracker:
+    def __init__(self, capacity: int, emit=None, max_open_traces: int = 512,
+                 keep_completed: int = 16):
+        self.capacity = int(capacity)
+        self._emit = emit  # callable(name, **fields) — MetricLogger.event
+        self._birth = np.zeros(self.capacity, np.float64)  # 0 = never filled
+        self._traced = np.zeros(self.capacity, bool)
+        self._slot_trace: Dict[int, int] = {}   # slot -> open trace id
+        self._open: "dict[int, dict]" = {}
+        self._max_open = int(max_open_traces)
+        self._completed: deque = deque(maxlen=int(keep_completed))
+        self.completed_count = 0
+        self.abandoned_count = 0   # slots recycled before the trace closed
+        self._lock = threading.Lock()
+        # True age at sample time, seconds (ms fields in the summary).
+        self.age_hist = LatencyHistogram(min_s=1e-3, max_s=7200.0,
+                                         per_decade=10)
+        self.span_hists = {
+            "act_to_ingest": LatencyHistogram(min_s=1e-4, max_s=3600.0),
+            "ingest_to_first_sample": LatencyHistogram(min_s=1e-4,
+                                                       max_s=7200.0),
+            "act_to_trained": LatencyHistogram(min_s=1e-4, max_s=7200.0),
+        }
+
+    # -- hand-off hooks ----------------------------------------------------
+
+    def on_ingest(self, indices, t_act: Optional[float] = None,
+                  trace_id: int = 0, wid: Optional[int] = None) -> None:
+        """A chunk landed in replay slots ``indices`` (the array
+        ``PrioritizedReplay.add`` returned).  ``t_act`` is the producer's
+        send time (wire ``sent_t``); ``trace_id`` nonzero marks the chunk
+        traced."""
+        idx = np.asarray(indices, np.int64)
+        if idx.size == 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            # Recycled slots first: an overwrite before the old trace
+            # completed abandons it (the transition is gone — that IS the
+            # finding, not an error).
+            if self._traced[idx].any():
+                for s in idx[self._traced[idx]]:
+                    self._abandon_slot_locked(int(s))
+            self._birth[idx] = now
+            if trace_id:
+                if len(self._open) >= self._max_open:
+                    oldest = next(iter(self._open))
+                    self._drop_trace_locked(oldest, abandoned=True)
+                self._open[int(trace_id)] = {
+                    "trace_id": int(trace_id),
+                    "wid": wid,
+                    "slots": idx.copy(),
+                    "t_act": float(t_act) if t_act is not None else now,
+                    "t_ingest": now,
+                    "rows": int(idx.size),
+                }
+                self._traced[idx] = True
+                for s in idx:
+                    self._slot_trace[int(s)] = int(trace_id)
+
+    def on_sample(self, indices) -> None:
+        """A prioritized batch was sampled at these replay slots."""
+        idx = np.asarray(indices, np.int64)
+        if idx.size == 0:
+            return
+        now = time.monotonic()
+        births = self._birth[idx]
+        for age in (now - births[births > 0.0]):
+            self.age_hist.record(float(age))
+        if not self._traced[idx].any():
+            return
+        with self._lock:
+            for s in idx[self._traced[idx]]:
+                rec = self._open.get(self._slot_trace.get(int(s), -1))
+                if rec is not None and "t_first_sample" not in rec:
+                    rec["t_first_sample"] = now
+
+    def on_trained(self, indices) -> None:
+        """The train step that consumed these slots has completed (the
+        deferred priority write-back point — its device work is forced)."""
+        idx = np.asarray(indices, np.int64)
+        if idx.size == 0 or not self._traced[idx].any():
+            return
+        now = time.monotonic()
+        done: List[dict] = []
+        with self._lock:
+            for s in idx[self._traced[idx]]:
+                tid = self._slot_trace.get(int(s))
+                rec = self._open.get(tid) if tid is not None else None
+                if rec is None or "t_first_sample" not in rec:
+                    continue  # trained before sampled can't happen; guard
+                rec["t_trained"] = now
+                self._drop_trace_locked(tid, abandoned=False)
+                done.append(rec)
+        for rec in done:
+            self._complete(rec)
+
+    # -- internals ---------------------------------------------------------
+
+    def _abandon_slot_locked(self, slot: int) -> None:
+        tid = self._slot_trace.get(slot)
+        if tid is not None and tid in self._open:
+            self._drop_trace_locked(tid, abandoned=True)
+
+    def _drop_trace_locked(self, trace_id: int, abandoned: bool) -> None:
+        rec = self._open.pop(trace_id, None)
+        if rec is None:
+            return
+        slots = rec["slots"]
+        self._traced[slots] = False
+        for s in slots:
+            self._slot_trace.pop(int(s), None)
+        if abandoned:
+            self.abandoned_count += 1
+
+    def _complete(self, rec: dict) -> None:
+        spans = {
+            "act_to_ingest_ms": (rec["t_ingest"] - rec["t_act"]) * 1e3,
+            "ingest_to_first_sample_ms":
+                (rec["t_first_sample"] - rec["t_ingest"]) * 1e3,
+            "first_sample_to_trained_ms":
+                (rec["t_trained"] - rec["t_first_sample"]) * 1e3,
+            "act_to_trained_ms": (rec["t_trained"] - rec["t_act"]) * 1e3,
+        }
+        self.span_hists["act_to_ingest"].record(
+            max(0.0, rec["t_ingest"] - rec["t_act"])
+        )
+        self.span_hists["ingest_to_first_sample"].record(
+            max(0.0, rec["t_first_sample"] - rec["t_ingest"])
+        )
+        self.span_hists["act_to_trained"].record(
+            max(0.0, rec["t_trained"] - rec["t_act"])
+        )
+        event = {
+            "trace_id": rec["trace_id"],
+            "wid": rec["wid"],
+            "rows": rec["rows"],
+            **{k: round(rec[k], 6) for k in SPAN_ORDER},
+            **{k: round(v, 3) for k, v in spans.items()},
+        }
+        self.completed_count += 1
+        self._completed.append(event)
+        if self._emit is not None:
+            try:
+                self._emit("lineage_span", **event)
+            except Exception:  # noqa: BLE001 — tracing must not kill a run
+                pass
+
+    # -- snapshot ----------------------------------------------------------
+
+    def summary(self, include_recent: bool = True) -> dict:
+        """The /varz + JSONL lineage section: true age-of-experience
+        distribution at sample time plus span percentiles.  The JSONL
+        emit passes ``include_recent=False`` — completed spans already
+        ride the stream as their own ``lineage_span`` events."""
+        with self._lock:
+            open_n = len(self._open)
+        age = self.age_hist.summary()
+        age["buckets_s"] = self.age_hist.buckets()
+        out = {
+            "age_at_sample": age,
+            "spans_ms": {
+                k: h.summary() for k, h in self.span_hists.items()
+                if h.count
+            },
+            "traces_open": open_n,
+            "traces_completed": self.completed_count,
+            "traces_abandoned": self.abandoned_count,
+        }
+        if include_recent:
+            out["recent_spans"] = list(self._completed)
+        return out

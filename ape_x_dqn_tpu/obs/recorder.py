@@ -1,0 +1,151 @@
+"""Flight recorder: a bounded ring of recent events, flushed to a
+post-mortem file when the process dies telling — and salvageable from
+shared memory when it dies without a word.
+
+``_salvage_incarnation`` forensics were guesswork: after a SIGKILL the
+parent knew only what the experience ring implied (records committed, a
+torn tail).  The recorder turns that into data, three ways:
+
+  * **In memory** — ``record(kind, ...)`` appends to a deque of
+    ``obs.recorder_depth`` recent events: cheap enough for per-quantum /
+    per-emit cadence, never per step.
+  * **Mirrored to shm** — with a ``shm_sink`` (the worker's
+    ``WorkerStatsBlock``), every event also lands in the block's event
+    ring, so the parent can read a SIGKILLed worker's last moves.
+  * **Dumped on fault/SIGTERM** — ``dump()`` writes one JSON file under
+    ``<postmortem_dir>/`` (tmp + rename: a crash mid-dump leaves no torn
+    artifact); ``install_sigterm`` chains the previous handler so a
+    terminated trainer flushes before dying.
+
+Import-light by contract (stdlib only): worker children construct one
+before jax exists in their process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+
+class FlightRecorder:
+    def __init__(self, name: str = "proc", depth: int = 256,
+                 shm_sink=None):
+        self.name = name
+        self._events: deque = deque(maxlen=int(depth))
+        self._sink = shm_sink
+        self._lock = threading.Lock()
+        self._snapshot_fns: Dict[str, Callable[[], dict]] = {}
+        self.dumped: List[str] = []
+
+    def add_snapshot_provider(self, name: str,
+                              fn: Callable[[], dict]) -> None:
+        """State captured AT DUMP TIME (registry snapshot, pool stats) —
+        the "what was true when it died" half of a post-mortem."""
+        self._snapshot_fns[name] = fn
+
+    def record(self, kind: str, **fields) -> dict:
+        rec = {"t": round(time.monotonic(), 4), "kind": kind, **fields}
+        with self._lock:
+            self._events.append(rec)
+        if self._sink is not None:
+            try:
+                self._sink.record_event(rec)
+            except Exception:  # noqa: BLE001 — recording must never kill
+                pass
+        return rec
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def dump(self, out_dir: str, reason: str,
+             extra: Optional[dict] = None) -> Optional[str]:
+        """Write one post-mortem JSON under ``out_dir``; returns the path
+        (None if ``out_dir`` is falsy — recording configured off).  Never
+        raises: the dump runs on failure paths where a second exception
+        would mask the first."""
+        if not out_dir:
+            return None
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            snapshots: dict = {}
+            for name, fn in self._snapshot_fns.items():
+                try:
+                    snapshots[name] = fn()
+                except Exception as e:  # noqa: BLE001
+                    snapshots[name] = {
+                        "error": f"{type(e).__name__}: {e}"
+                    }
+            record = {
+                "name": self.name,
+                "reason": reason,
+                "pid": os.getpid(),
+                "wall_time": time.time(),
+                "t_mono": time.monotonic(),
+                "events": self.events(),
+                "snapshots": snapshots,
+                "extra": extra or {},
+            }
+            fname = (f"{self.name}-pid{os.getpid()}-{reason}-"
+                     f"{int(time.time() * 1e3)}.json")
+            path = os.path.join(out_dir, fname)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(record, f, indent=1, default=str)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            self.dumped.append(path)
+            return path
+        except Exception:  # noqa: BLE001 — see docstring
+            return None
+
+    def install_sigterm(self, out_dir: str) -> bool:
+        """Flush-on-SIGTERM: dump, then run the previously-installed
+        handler (or re-raise the default kill).  Signal handlers can only
+        live on the main thread — returns False (no-op) elsewhere, which
+        is the serve/--attach and test-thread case."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _handler(signum, frame):
+            self.dump(out_dir, "sigterm")
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _handler)
+        return True
+
+
+def write_postmortem(out_dir: str, name: str, reason: str,
+                     record: dict) -> Optional[str]:
+    """One-shot post-mortem writer for records assembled by someone else —
+    the parent writing a SIGKILLed worker's salvaged stats block
+    (runtime/process_actors._salvage_incarnation).  Same tmp+rename
+    discipline, same never-raises contract."""
+    if not out_dir:
+        return None
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        fname = f"{name}-{reason}-{int(time.time() * 1e3)}.json"
+        path = os.path.join(out_dir, fname)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"name": name, "reason": reason,
+                       "wall_time": time.time(), **record}, f,
+                      indent=1, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+    except Exception:  # noqa: BLE001 — salvage must not kill the parent
+        return None

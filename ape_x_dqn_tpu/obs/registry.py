@@ -1,0 +1,296 @@
+"""Cross-process metrics registry: typed instruments + snapshot + export.
+
+The repo grew four runtime tiers (process actors, the fused learner, the
+async checkpoint writer, the serving tier) and each invented its own
+ad-hoc JSONL fragment.  This registry is the shared schema they plug
+into: typed **counters / gauges / histograms** built on the proven
+primitives in ``utils/metrics`` (``RateCounter`` windows,
+``LatencyHistogram`` log buckets), plus **providers** — callables whose
+dict snapshots fold in the stats surfaces that already exist
+(``ProcessActorPool.transport_stats``, ``IncrementalCheckpointer.stats``,
+``PolicyServer.stats``, per-worker shm stats blocks) without rewriting
+them.  One ``snapshot()`` is the /varz JSON, one ``prometheus_text()``
+is the /metrics scrape (obs/exporter.py), and the same dict rides the
+JSONL emit — three views, one source of truth.
+
+``Health`` is the /healthz source: components **beat** (learner loop,
+ingest pump) or register an **age function** (threads that already track
+a last-activity time); a heartbeat older than ``stale_after_s`` marks
+the component — and the whole process — degraded.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ape_x_dqn_tpu.utils.metrics import LatencyHistogram, RateCounter
+
+
+class Counter:
+    """Monotone counter with a sliding-window rate (events/s)."""
+
+    kind = "counter"
+
+    def __init__(self, help: str = "", window_s: float = 30.0):
+        self.help = help
+        self._value = 0.0
+        self._rate = RateCounter(window_s)
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up — use a Gauge")
+        with self._lock:
+            self._value += n
+        self._rate.add(n)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def rate(self) -> float:
+        return self._rate.rate()
+
+    def snapshot(self):
+        return {"total": self.value, "rate_s": round(self.rate(), 3)}
+
+
+class Gauge:
+    """Last-write-wins scalar.  A float attribute store is atomic under
+    CPython, so reads need no lock; ``set_fn`` turns it into a computed
+    gauge evaluated at snapshot time."""
+
+    kind = "gauge"
+
+    def __init__(self, help: str = ""):
+        self.help = help
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:  # noqa: BLE001 — a scrape must never crash
+                return float("nan")
+        return self._value
+
+    def snapshot(self):
+        return self.value
+
+
+class Histogram:
+    """Log-bucketed distribution (``utils.metrics.LatencyHistogram``):
+    O(1) observe on hot paths, percentile summary + raw buckets out."""
+
+    kind = "histogram"
+
+    def __init__(self, help: str = "", min_s: float = 1e-5,
+                 max_s: float = 120.0, per_decade: int = 20):
+        self.help = help
+        self._hist = LatencyHistogram(
+            min_s=min_s, max_s=max_s, per_decade=per_decade
+        )
+
+    def observe(self, value: float) -> None:
+        self._hist.record(value)
+
+    @property
+    def count(self) -> int:
+        return self._hist.count
+
+    def percentile(self, p: float) -> float:
+        return self._hist.percentile(p)
+
+    def snapshot(self):
+        out = self._hist.summary()
+        out["buckets"] = self._hist.buckets()
+        return out
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(*parts: str) -> str:
+    return _NAME_RE.sub("_", "_".join(p for p in parts if p))
+
+
+def _flatten(prefix: str, value, out: list) -> None:
+    """Numeric leaves of a nested snapshot dict → (name, value) pairs —
+    how provider dicts (transport stats, worker sweeps) become scrapeable
+    series without per-source schemas."""
+    if isinstance(value, bool):
+        out.append((prefix, int(value)))
+    elif isinstance(value, (int, float)):
+        out.append((prefix, value))
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            _flatten(_prom_name(prefix, str(k)), v, out)
+
+
+class MetricsRegistry:
+    """Named typed instruments + pluggable snapshot providers."""
+
+    def __init__(self, prefix: str = "apex"):
+        self.prefix = prefix
+        self._instruments: Dict[str, object] = {}
+        self._providers: Dict[str, Callable[[], dict]] = {}
+        self._lock = threading.Lock()
+
+    # -- instruments -------------------------------------------------------
+
+    def _get_or_create(self, name: str, cls, **kwargs):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(**kwargs)
+                self._instruments[name] = inst
+            elif not isinstance(inst, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {inst.kind}"
+                )
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, Gauge, help=help)
+
+    def histogram(self, name: str, help: str = "", min_s: float = 1e-5,
+                  max_s: float = 120.0, per_decade: int = 20) -> Histogram:
+        return self._get_or_create(
+            name, Histogram, help=help, min_s=min_s, max_s=max_s,
+            per_decade=per_decade,
+        )
+
+    # -- providers ---------------------------------------------------------
+
+    def register_provider(self, name: str, fn: Callable[[], dict]) -> None:
+        """Fold ``fn()``'s dict into every snapshot under ``name`` — the
+        adapter for stats surfaces that already exist elsewhere."""
+        with self._lock:
+            self._providers[name] = fn
+
+    def unregister_provider(self, name: str) -> None:
+        with self._lock:
+            self._providers.pop(name, None)
+
+    # -- views -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /varz JSON: typed instruments under their names, provider
+        dicts under theirs.  Provider failures degrade to an ``error``
+        entry — a half-dead run is exactly when a scrape matters most."""
+        with self._lock:
+            instruments = dict(self._instruments)
+            providers = dict(self._providers)
+        out: dict = {"t_mono": round(time.monotonic(), 3)}
+        for name, inst in instruments.items():
+            out[name] = inst.snapshot()
+        for name, fn in providers.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # noqa: BLE001 — scrape must not crash
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition: typed instruments natively
+        (counter total, gauge value, histogram quantile series +
+        _count/_sum), provider dicts flattened to numeric-leaf gauges."""
+        with self._lock:
+            instruments = dict(self._instruments)
+            providers = dict(self._providers)
+        lines: list = []
+        for name, inst in sorted(instruments.items()):
+            pname = _prom_name(self.prefix, name)
+            if inst.help:
+                lines.append(f"# HELP {pname} {inst.help}")
+            if isinstance(inst, Counter):
+                lines.append(f"# TYPE {pname} counter")
+                lines.append(f"{pname}_total {inst.value:g}")
+            elif isinstance(inst, Gauge):
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(f"{pname} {inst.value:g}")
+            elif isinstance(inst, Histogram):
+                lines.append(f"# TYPE {pname} summary")
+                for q in (0.5, 0.95, 0.99):
+                    v = inst.percentile(q * 100)
+                    v = v if v == v else 0.0  # NaN (empty) → 0
+                    lines.append(f'{pname}{{quantile="{q}"}} {v:g}')
+                lines.append(f"{pname}_count {inst.count}")
+        flat: list = []
+        for name, fn in sorted(providers.items()):
+            try:
+                _flatten(_prom_name(self.prefix, name), fn(), flat)
+            except Exception:  # noqa: BLE001 — scrape must not crash
+                continue
+        for pname, value in flat:
+            lines.append(f"{pname} {value:g}")
+        return "\n".join(lines) + "\n"
+
+
+class Health:
+    """Per-component liveness for /healthz.
+
+    ``beat(name)`` for loops that can call in; ``register(name, age_fn)``
+    for components that already track their own last-activity time.
+    ``status()`` marks any component whose age exceeds ``stale_after_s``
+    (overridable per component) degraded, and the process with it.
+    """
+
+    def __init__(self, stale_after_s: float = 15.0):
+        self.stale_after_s = float(stale_after_s)
+        self._beats: Dict[str, float] = {}
+        self._age_fns: Dict[str, Callable[[], float]] = {}
+        self._stale: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def beat(self, name: str) -> None:
+        with self._lock:
+            self._beats[name] = time.monotonic()
+
+    def register(self, name: str, age_fn: Callable[[], float],
+                 stale_after_s: Optional[float] = None) -> None:
+        with self._lock:
+            self._age_fns[name] = age_fn
+            if stale_after_s is not None:
+                self._stale[name] = float(stale_after_s)
+
+    def status(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            beats = dict(self._beats)
+            age_fns = dict(self._age_fns)
+            stale = dict(self._stale)
+        components: dict = {}
+        ok_all = True
+        for name, t in beats.items():
+            age = now - t
+            ok = age <= stale.get(name, self.stale_after_s)
+            components[name] = {"age_s": round(age, 3), "ok": ok}
+            ok_all &= ok
+        for name, fn in age_fns.items():
+            try:
+                age = float(fn())
+            except Exception:  # noqa: BLE001 — a dead age fn IS degraded
+                age = float("inf")
+            ok = age <= stale.get(name, self.stale_after_s)
+            components[name] = {"age_s": round(min(age, 1e12), 3), "ok": ok}
+            ok_all &= ok
+        return {
+            "status": "ok" if ok_all else "degraded",
+            "components": components,
+        }

@@ -1,0 +1,254 @@
+"""Per-worker fixed-layout shared-memory stats block — metrics that
+survive SIGKILL.
+
+The process-actor transport made the EXPERIENCE path kill-safe
+(runtime/shm_ring.py); this is the same discipline for the worker's
+METRICS.  Children are deliberately import-light (no jax at module scope,
+no logger plumbing), so before this block existed they emitted nothing:
+the parent saw env-steps only as a derived count from drained chunks, ε
+and per-worker health not at all, and a SIGKILLed worker's last known
+state was pure guesswork.  Now every worker incarnation gets one small
+``/dev/shm`` segment with a parent-defined slot layout:
+
+  * **Slots** — named f64 cells (env_steps, chunks, ε stats, param
+    version, ...).  The worker is the single writer; the parent sweeps
+    them on its poll cadence.  An 8-byte aligned store is effectively
+    atomic on x86; a torn read would corrupt one display sample of one
+    gauge, never program state, so slots carry no locks at all.
+  * **Event ring** — ``depth`` fixed 256-byte slots of JSON event records
+    (the worker-side flight-recorder mirror, obs/recorder.py).  The writer
+    overwrites the oldest slot; a SIGKILL mid-write leaves exactly one
+    undecodable slot, which the reader counts as torn and skips — same
+    detect-don't-deliver contract as the experience ring's CRC framing.
+  * **Heartbeat + seq** — writer-stamped CLOCK_MONOTONIC time (comparable
+    across processes on one Linux host) and an update counter, so the
+    parent distinguishes "alive but idle" from "dead" without signals.
+
+Lifecycle mirrors the experience ring: the PARENT creates (and at
+teardown unlinks) one block per worker incarnation; the worker attaches
+as writer.  After a SIGKILL the segment persists until the parent's
+salvage pass reads the final slot values and the last events — the
+post-mortem record `_salvage_incarnation` writes (runtime/process_actors).
+
+Import-light by contract: stdlib only — worker children import this
+before jax exists in their process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+from multiprocessing import shared_memory
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_MAGIC = b"APXS"
+_VERSION = 1
+
+# Header (64 bytes, all fields 8-byte aligned):
+#   0: 4s magic | u32 version
+#   8: u64 n_slots
+#  16: u64 event ring depth (slots)
+#  24: u64 events written (monotone; slot = count % depth)   (writer-owned)
+#  32: f64 heartbeat (CLOCK_MONOTONIC seconds)               (writer-owned)
+#  40: u64 writer pid                                        (writer-owned)
+#  48: u64 seq — bumped once per writer update batch         (writer-owned)
+#  56: u64 reserved
+_HEADER_SIZE = 64
+_IDENT = struct.Struct("<4sIQQ")
+_U64 = struct.Struct("<Q")
+_F64 = struct.Struct("<d")
+
+_OFF_EV_COUNT = 24
+_OFF_HEARTBEAT = 32
+_OFF_PID = 40
+_OFF_SEQ = 48
+
+_NAMES_SIZE = 2048          # JSON slot-name table, creator-written, fixed
+_EVENT_SLOT = 256           # u32 len | JSON payload (truncated)
+
+# The slot vocabulary ProcessActorPool provisions for actor workers — one
+# place so the worker writer, the parent sweep, and the dashboard agree.
+WORKER_SLOTS: Tuple[str, ...] = (
+    "env_steps",        # fleet.step_count (this incarnation)
+    "chunks",           # chunks committed to the experience ring
+    "transitions",      # transitions across those chunks
+    "param_version",    # newest adopted param snapshot
+    "eps_mean",         # ε-ladder slice stats for this worker's actors
+    "eps_min",
+    "eps_max",
+    "episodes",         # episode stats reported so far
+    "collect_s",        # cumulative seconds inside fleet.collect
+    "write_s",          # cumulative seconds writing the experience ring
+)
+
+
+class WorkerStatsBlock:
+    """One shared-memory stats block (slots + event ring), SPSC like the
+    experience ring: the creator (parent) reads, the attacher (worker)
+    writes.  All accessors are safe to call after the writer died."""
+
+    def __init__(self, slots: Optional[Sequence[str]] = None,
+                 name: Optional[str] = None, create: bool = True,
+                 event_depth: int = 64):
+        if create:
+            if not slots:
+                raise ValueError("creator must define the slot layout")
+            names = list(slots)
+            blob = json.dumps(names).encode()
+            if len(blob) > _NAMES_SIZE:
+                raise ValueError(
+                    f"slot-name table of {len(blob)} bytes exceeds "
+                    f"{_NAMES_SIZE}"
+                )
+            depth = int(event_depth)
+            if depth < 1:
+                raise ValueError("event_depth must be >= 1")
+            size = (_HEADER_SIZE + _NAMES_SIZE + 8 * len(names)
+                    + depth * _EVENT_SLOT)
+            self._shm = shared_memory.SharedMemory(create=True, size=size)
+            self._shm.buf[:size] = b"\x00" * size
+            _IDENT.pack_into(self._shm.buf, 0, _MAGIC, _VERSION,
+                             len(names), depth)
+            self._shm.buf[_HEADER_SIZE:_HEADER_SIZE + len(blob)] = blob
+            self._names = names
+            self._depth = depth
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+            magic, version, n_slots, depth = _IDENT.unpack_from(
+                self._shm.buf, 0
+            )
+            if magic != _MAGIC or version != _VERSION:
+                raise ValueError(f"not an APXS v{_VERSION} block: {name}")
+            blob = bytes(
+                self._shm.buf[_HEADER_SIZE:_HEADER_SIZE + _NAMES_SIZE]
+            ).split(b"\x00", 1)[0]
+            self._names = json.loads(blob)
+            if len(self._names) != n_slots:
+                raise ValueError(f"corrupt slot-name table in {name}")
+            self._depth = int(depth)
+            # Writer identity lands at attach, so even a worker killed
+            # before its first update leaves an identifiable block.
+            _U64.pack_into(self._shm.buf, _OFF_PID, os.getpid())
+        self._owner = create
+        self._index = {n: i for i, n in enumerate(self._names)}
+        self._slots_off = _HEADER_SIZE + _NAMES_SIZE
+        self._events_off = self._slots_off + 8 * len(self._names)
+
+    # -- identity ---------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    @property
+    def slot_names(self) -> List[str]:
+        return list(self._names)
+
+    @property
+    def pid(self) -> int:
+        return _U64.unpack_from(self._shm.buf, _OFF_PID)[0]
+
+    @property
+    def seq(self) -> int:
+        return _U64.unpack_from(self._shm.buf, _OFF_SEQ)[0]
+
+    @property
+    def events_written(self) -> int:
+        return _U64.unpack_from(self._shm.buf, _OFF_EV_COUNT)[0]
+
+    # -- writer side (the worker) -----------------------------------------
+
+    def set(self, slot: str, value: float) -> None:
+        _F64.pack_into(
+            self._shm.buf, self._slots_off + 8 * self._index[slot],
+            float(value),
+        )
+
+    def add(self, slot: str, delta: float) -> None:
+        # Single-writer read-modify-write — no lock needed by contract.
+        self.set(slot, self.get(slot) + float(delta))
+
+    def get(self, slot: str) -> float:
+        return _F64.unpack_from(
+            self._shm.buf, self._slots_off + 8 * self._index[slot]
+        )[0]
+
+    def update(self, **slots: float) -> None:
+        """Batch slot write + heartbeat + seq bump — the once-per-quantum
+        call a worker makes."""
+        for k, v in slots.items():
+            self.set(k, v)
+        self.heartbeat()
+
+    def heartbeat(self) -> None:
+        _F64.pack_into(self._shm.buf, _OFF_HEARTBEAT, time.monotonic())
+        _U64.pack_into(self._shm.buf, _OFF_SEQ, self.seq + 1)
+
+    def record_event(self, record: Dict) -> None:
+        """Append one JSON event to the ring (oldest slot overwritten).
+        Payload is truncated to the slot size — flight-recorder events are
+        small by design; a truncated one decodes as torn, never as lies."""
+        payload = json.dumps(record).encode()[:_EVENT_SLOT - 4]
+        count = self.events_written
+        off = self._events_off + (count % self._depth) * _EVENT_SLOT
+        # Payload first, length last, count bump last of all: a SIGKILL
+        # between any two stores leaves a slot that fails to decode (stale
+        # length over new bytes, or an unbumped count hiding the slot).
+        self._shm.buf[off + 4:off + 4 + len(payload)] = payload
+        struct.pack_into("<I", self._shm.buf, off, len(payload))
+        _U64.pack_into(self._shm.buf, _OFF_EV_COUNT, count + 1)
+
+    # -- reader side (the parent; valid after the writer died) -------------
+
+    def heartbeat_age_s(self) -> float:
+        t = _F64.unpack_from(self._shm.buf, _OFF_HEARTBEAT)[0]
+        if t <= 0.0:
+            return float("inf")  # never beat
+        return max(0.0, time.monotonic() - t)
+
+    def snapshot(self) -> Dict:
+        """All slots plus writer identity/liveness fields — one sweep."""
+        out: Dict = {n: self.get(n) for n in self._names}
+        out["pid"] = self.pid
+        out["seq"] = self.seq
+        out["heartbeat_age_s"] = round(self.heartbeat_age_s(), 3)
+        out["events_written"] = self.events_written
+        return out
+
+    def recent_events(self, max_events: Optional[int] = None) -> Tuple[List[Dict], int]:
+        """(events oldest→newest, torn_count): the last ``max_events``
+        decodable records.  A slot that fails to frame or parse — the
+        writer was killed mid-write, or the record was truncated — counts
+        as torn and is skipped, mirroring the experience ring's
+        torn-tail accounting."""
+        count = self.events_written
+        depth = self._depth
+        n = min(count, depth, max_events if max_events else depth)
+        events: List[Dict] = []
+        torn = 0
+        for k in range(count - n, count):
+            off = self._events_off + (k % depth) * _EVENT_SLOT
+            (length,) = struct.unpack_from("<I", self._shm.buf, off)
+            if not 0 < length <= _EVENT_SLOT - 4:
+                torn += 1
+                continue
+            raw = bytes(self._shm.buf[off + 4:off + 4 + length])
+            try:
+                events.append(json.loads(raw))
+            except ValueError:
+                torn += 1
+        return events, torn
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._shm.close()
+
+    def unlink(self) -> None:
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass

@@ -1,0 +1,126 @@
+"""On-demand ``jax.profiler`` capture, triggered from /varz?trace=1.
+
+The ROADMAP's open profiler item (the 4.5k→12.5k steps/s gap) has no
+committed trace partly because capturing one meant stopping the run and
+re-launching ``tools/trace_capture.py`` under the right config.  This
+hook removes that step: hit ``/varz?trace=1`` on a LIVE trainer and a
+background thread traces the next N learner steps into a TensorBoard
+logdir, then tries to parse the xplane protobuf into the same op-level
+JSON summary ``tools/trace_capture.py`` produces (its ``summarize_xplane``
+is loaded by file path — ``tools/`` is not a package — and skipped
+gracefully when tensorflow isn't importable).
+
+Platform discipline is inherited from ``utils/profiling.trace``: where
+the profiler plugin can't trace (the tunneled TPU), the capture degrades
+to a recorded no-op — hitting the endpoint must never kill a run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Optional
+
+
+def _load_summarizer():
+    """``tools/trace_capture.summarize_xplane`` by file path, or None —
+    the tools tree may be absent in an installed package, and its
+    tensorflow import is too heavy to pay at module scope."""
+    try:
+        import importlib.util
+
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        path = os.path.join(root, "tools", "trace_capture.py")
+        if not os.path.exists(path):
+            return None
+        spec = importlib.util.spec_from_file_location("_trace_capture", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.summarize_xplane
+    except Exception:  # noqa: BLE001 — summary is best-effort garnish
+        return None
+
+
+class TraceOnDemand:
+    """One in-flight capture at a time; ``trigger()`` returns immediately
+    with a status dict (the /varz reply), the capture thread does the
+    waiting."""
+
+    def __init__(self, step_fn: Optional[Callable[[], int]] = None,
+                 steps: int = 512, out_dir: Optional[str] = None,
+                 timeout_s: float = 60.0):
+        self._step_fn = step_fn
+        self._steps = int(steps)
+        self._out_dir = out_dir
+        self._timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        self._busy = False
+        self.last: dict = {"state": "idle"}
+
+    def trigger(self, steps: Optional[int] = None) -> dict:
+        with self._lock:
+            if self._busy:
+                return {"state": "already-running", **self.last}
+            self._busy = True
+        n = int(steps) if steps else self._steps
+        logdir = self._out_dir or tempfile.mkdtemp(prefix="obs_trace_")
+        self.last = {"state": "capturing", "logdir": logdir, "steps": n}
+        threading.Thread(
+            target=self._capture, args=(logdir, n),
+            name="obs-trace-capture", daemon=True,
+        ).start()
+        return dict(self.last)
+
+    def status(self) -> dict:
+        return dict(self.last)
+
+    def _capture(self, logdir: str, n: int) -> None:
+        from ape_x_dqn_tpu.utils.profiling import trace
+
+        rec = {"logdir": logdir, "steps_requested": n}
+        try:
+            start = self._step_fn() if self._step_fn else 0
+            deadline = time.monotonic() + self._timeout_s
+            t0 = time.monotonic()
+            with trace(logdir) as started:
+                rec["trace_started"] = bool(started)
+                if self._step_fn is not None:
+                    while (self._step_fn() < start + n
+                           and time.monotonic() < deadline):
+                        time.sleep(0.05)
+                    rec["steps_traced"] = self._step_fn() - start
+                else:
+                    time.sleep(min(2.0, self._timeout_s))
+            rec["wall_s"] = round(time.monotonic() - t0, 3)
+            if rec["trace_started"]:
+                summarize = _load_summarizer()
+                if summarize is not None:
+                    try:
+                        rec["summary"] = summarize(logdir)
+                    except Exception as e:  # noqa: BLE001 — best-effort
+                        rec["summary"] = {
+                            "error": f"{type(e).__name__}: {e}"
+                        }
+                try:
+                    with open(os.path.join(logdir, "summary.json"),
+                              "w") as f:
+                        json.dump(rec, f, default=str)
+                except OSError:
+                    pass
+                rec["state"] = "done"
+            else:
+                # The utils/profiling.trace degraded path: the platform's
+                # profiler can't trace — recorded, not raised.
+                rec["state"] = "unavailable"
+        except Exception as e:  # noqa: BLE001 — must never kill the run
+            rec["state"] = "error"
+            rec["reason"] = f"{type(e).__name__}: {e}"
+        finally:
+            self.last = rec
+            with self._lock:
+                self._busy = False

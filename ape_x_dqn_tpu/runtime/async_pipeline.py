@@ -123,8 +123,18 @@ class _ActorWorker:
     def __init__(self, comps, store: ParamStore, stop: threading.Event,
                  logger: MetricLogger, fps: RateCounter,
                  max_restarts: int = 3, quantum: Optional[int] = None,
-                 sink=None, seed_base: int = 0):
+                 sink=None, seed_base: int = 0, lineage=None,
+                 trace_sample_rate: float = 0.0):
         self._comps = comps
+        # Lineage (obs/lineage): thread-mode chunks have no wire envelope,
+        # so the trace id is stamped HERE, at the sink hand-off — t_act and
+        # t_ingest coincide (the flush happened microseconds ago in
+        # collect), which is truthful for in-process actors.
+        self._lineage = lineage
+        self._trace_rate = float(trace_sample_rate)
+        import random as _random
+
+        self._trace_rng = _random.Random(0x0B5 ^ seed_base)
         self._store = store
         self._stop = stop
         self._logger = logger
@@ -203,9 +213,15 @@ class _ActorWorker:
             quantum = min(self._quantum, max_steps - fleet.step_count)
             chunks, stats = fleet.collect(quantum, param_source=self._store)
             for chunk in chunks:
-                self._sink(chunk.priorities, chunk.transitions)
+                idx = self._sink(chunk.priorities, chunk.transitions)
                 self.actor_steps += chunk.actor_steps
                 self._fps.add(chunk.actor_steps)
+                if self._lineage is not None and idx is not None:
+                    trace_id = 0
+                    if self._trace_rate \
+                            and self._trace_rng.random() < self._trace_rate:
+                        trace_id = self._trace_rng.getrandbits(63) or 1
+                    self._lineage.on_ingest(idx, trace_id=trace_id)
             if stats:
                 with self._ep_lock:
                     self.episodes.extend(stats)
@@ -339,6 +355,34 @@ class AsyncPipeline:
             self.comps.state = sharded_state
         else:
             self.train_step = self.comps.make_train_step()
+        # --- observability layer (ape_x_dqn_tpu/obs) ----------------------
+        # Registry + health are always built (they are cheap dicts); the
+        # HTTP exporter only when obs.export_port says so.  Lineage runs on
+        # the host-replay path only — the fused HBM replay never surfaces
+        # sample indices to the host (that is its whole point), so there
+        # lineage ends at ingest.
+        from ape_x_dqn_tpu.obs import (
+            FlightRecorder,
+            Health,
+            LineageTracker,
+            MetricsRegistry,
+        )
+
+        ocfg = self.cfg.obs
+        self.obs_registry = MetricsRegistry()
+        self.health = Health(stale_after_s=ocfg.heartbeat_stale_s)
+        self._postmortem_dir = self._resolve_postmortem_dir()
+        self.recorder = FlightRecorder(
+            "trainer", depth=ocfg.recorder_depth
+        )
+        self.recorder.add_snapshot_provider(
+            "varz", self.obs_registry.snapshot
+        )
+        self._lineage = None
+        if self.fused is None:
+            self._lineage = LineageTracker(
+                self.cfg.replay.capacity, emit=self.logger.event
+            )
         if self.cfg.actor.mode == "process":
             # Actors in CPU-only worker processes: params travel as
             # serialized snapshots through shared memory, experience through
@@ -353,6 +397,7 @@ class AsyncPipeline:
             pool = ProcessActorPool(
                 self.cfg, num_workers=self.cfg.actor.num_workers,
                 seed_base=self._proc_idx * 7919,  # per-host exploration
+                postmortem_dir=self._postmortem_dir,
             )
             self.store = pool.store
             # _params_host: under multi-host the state may already be
@@ -366,6 +411,13 @@ class AsyncPipeline:
                 logger=self.logger,
                 fps=self._fps,
                 stop_event=self.stop_event,
+                lineage=self._lineage,
+            )
+            self.obs_registry.register_provider(
+                "workers", pool.worker_stats
+            )
+            self.obs_registry.register_provider(
+                "xp_transport", pool.transport_stats
             )
         else:
             self.store = ParamStore(self._params_host(self.comps.state.params))
@@ -373,7 +425,22 @@ class AsyncPipeline:
                 self.comps, self.store, self.stop_event, self.logger,
                 self._fps, max_restarts=max_actor_restarts, sink=sink,
                 seed_base=self._proc_idx * 7919,
+                lineage=self._lineage,
+                trace_sample_rate=ocfg.trace_sample_rate,
             )
+        self.obs_registry.register_provider("learner", self._learner_varz)
+        self.obs_registry.register_provider(
+            "stage_us", self.timers.us_per_call
+        )
+        if self._lineage is not None:
+            self.obs_registry.register_provider(
+                "lineage", self._lineage.summary
+            )
+        # /healthz components (the exporter's liveness view): the learner
+        # loop beats inline; the ingest pump already tracks a heartbeat.
+        self.health.register(
+            "ingest", lambda: time.monotonic() - self.worker.heartbeat
+        )
         # Off-thread publisher (single-process): the learner snapshots
         # params with one cheap device-side copy; device_get + serialize +
         # store write happen on the publisher thread (see _AsyncPublisher —
@@ -432,6 +499,82 @@ class AsyncPipeline:
                 base_every=self.cfg.learner.checkpoint_base_every,
                 compress=self.cfg.learner.checkpoint_compress,
             )
+            self.obs_registry.register_provider(
+                "ckpt", self._ckpt_inc.stats
+            )
+            # The writer thread has no beat cadence (saves are sparse), so
+            # liveness is structural: thread alive and no recorded error.
+            self.health.register(
+                "ckpt_writer",
+                lambda: 0.0 if (
+                    self._ckpt_inc.error is None
+                    and (self._ckpt_inc._thread is None
+                         or self._ckpt_inc._thread.is_alive())
+                ) else float("inf"),
+            )
+        # The exporter thread last, once every provider is registered.
+        # Explicit ports bind on host 0 only (multi-host SPMD would
+        # collide); port 0 (ephemeral) is per-host safe.
+        from ape_x_dqn_tpu.obs import ObsServer, TraceOnDemand
+
+        self.trace_on_demand = TraceOnDemand(
+            step_fn=lambda: self._learner_step,
+            steps=self.cfg.obs.trace_steps,
+            out_dir=self.cfg.obs.trace_dir,
+        )
+        self.obs_server = None
+        self.obs_port = None
+        if self.cfg.obs.export_port is not None and (
+            self._proc_idx == 0 or self.cfg.obs.export_port == 0
+        ):
+            self.obs_server = ObsServer(
+                self.obs_registry, self.health,
+                port=self.cfg.obs.export_port,
+                trace_hook=self.trace_on_demand.trigger,
+            )
+            self.obs_port = self.obs_server.port
+            self.logger.event(
+                "obs_exporter", port=self.obs_port,
+                url=self.obs_server.url,
+            )
+
+    def _resolve_postmortem_dir(self) -> Optional[str]:
+        """obs.postmortem_dir policy: explicit path wins; "auto" lands
+        post-mortems under the checkpoint dir a checkpointed run already
+        owns, and stays off otherwise (no stray dirs from ad-hoc runs)."""
+        import os
+
+        d = self.cfg.obs.postmortem_dir
+        if d == "auto":
+            if self.cfg.learner.checkpoint_every:
+                return os.path.join(
+                    self.cfg.learner.checkpoint_dir, "postmortem"
+                )
+            return None
+        return d
+
+    def _learner_varz(self) -> dict:
+        """The learner section of every /varz scrape and /metrics flatten
+        — the same numbers the JSONL emit carries, readable mid-emit."""
+        out = {
+            "step": self._learner_step,
+            "steps_per_sec": round(self._steps_rate.rate(), 1),
+            "actor_fps": round(self._fps.rate(), 1),
+            "actor_steps": self.worker.actor_steps,
+            "actor_restarts": self.worker.restarts,
+            "param_version": self.store.version,
+            "actor_heartbeat_age": round(
+                time.monotonic() - self.worker.heartbeat, 3
+            ),
+        }
+        try:
+            out["replay_size"] = (
+                self.fused.size if self.fused is not None
+                else self.comps.replay.size()
+            )
+        except Exception:  # noqa: BLE001 — scrape must not crash
+            pass
+        return out
 
     def _maybe_eval(self):
         if not self._eval_every or self._learner_step < self._next_eval:
@@ -547,6 +690,7 @@ class AsyncPipeline:
         target = learner_steps if learner_steps is not None else cfg.learner.total_steps
         if self.fused is not None:
             return self._run_fused(target, warmup_timeout)
+        self._obs_run_start(target)
         self.worker.start()
         try:
             self._wait_for_warmup(warmup_timeout)
@@ -559,8 +703,11 @@ class AsyncPipeline:
                 metrics = None
                 state = self.comps.state
                 while self._learner_step < target and not self.stop_event.is_set():
+                    self.health.beat("learner")
                     with self.timers.stage("sample+place"):
                         host_indices, batch = queue.get()
+                    if self._lineage is not None:
+                        self._lineage.on_sample(host_indices)
                     with self.timers.stage("step_dispatch"):
                         state, metrics = self.train_step(state, batch)
                     # Keep the live state visible on self so a mid-run
@@ -578,6 +725,10 @@ class AsyncPipeline:
                             self.comps.replay.update_priorities(
                                 pending[0], self._priorities_host(pending[1])
                             )
+                        if self._lineage is not None:
+                            # The write-back forced the previous step's
+                            # device work — its slots are now TRAINED.
+                            self._lineage.on_trained(pending[0])
                     pending = (host_indices, metrics.priorities)
                     if self._learner_step % cfg.learner.publish_every == 0:
                         with self.timers.stage("publish"):
@@ -595,14 +746,20 @@ class AsyncPipeline:
                     self.comps.replay.update_priorities(
                         pending[0], self._priorities_host(pending[1])
                     )
+                    if self._lineage is not None:
+                        self._lineage.on_trained(pending[0])
             self._finish_publishes()
             self._finish_checkpoints()
+        except BaseException as e:
+            self._obs_fault(e)
+            raise
         finally:
             self.stop_event.set()
             self.worker.join()
             if self._publisher is not None:
                 self._publisher.close()
             self._close_checkpoints()
+            self._close_obs()
         if self.worker.error is not None:
             raise RuntimeError("actor worker died") from self.worker.error
         # Final emit carries the last step's metrics (one host sync) so the
@@ -618,6 +775,7 @@ class AsyncPipeline:
 
         cfg = self.cfg
         fused = self.fused
+        self._obs_run_start(target)
         self.worker.start()
         last_metrics = None
         inflight: list = []  # metrics of dispatched-but-unforced calls
@@ -638,6 +796,7 @@ class AsyncPipeline:
                 else None
             )
             while self._learner_step < target and not self.stop_event.is_set():
+                self.health.beat("learner")
                 with self.timers.stage("ingest"):
                     fused.ingest_staged(drain=self.worker.finished)
                 beta = beta_schedule(
@@ -686,12 +845,16 @@ class AsyncPipeline:
                 self._force_fused(inflight.pop(0))
             self._finish_publishes()
             self._finish_checkpoints()
+        except BaseException as e:
+            self._obs_fault(e)
+            raise
         finally:
             self.stop_event.set()
             self.worker.join()
             if self._publisher is not None:
                 self._publisher.close()
             self._close_checkpoints()
+            self._close_obs()
         if self.worker.error is not None:
             raise RuntimeError("actor worker died") from self.worker.error
         if last_metrics is not None:
@@ -754,8 +917,11 @@ class AsyncPipeline:
                 )
         # Learner-visible checkpoint stall — the number the incremental
         # subsystem exists to shrink (bench.py checkpoint_stall).
-        self.logger.log(
-            "ckpt/learner_stall_ms", (time.perf_counter() - t0) * 1e3
+        stall_ms = (time.perf_counter() - t0) * 1e3
+        self.logger.log("ckpt/learner_stall_ms", stall_ms)
+        self.recorder.record(
+            "checkpoint", step=self._learner_step,
+            stall_ms=round(stall_ms, 1),
         )
 
     def _save_fused_checkpoint(self) -> str:
@@ -781,10 +947,54 @@ class AsyncPipeline:
                 self.cfg.learner.checkpoint_dir, self.fused.state,
                 replay=self.fused,
             )
-        self.logger.log(
-            "ckpt/learner_stall_ms", (time.perf_counter() - t0) * 1e3
+        stall_ms = (time.perf_counter() - t0) * 1e3
+        self.logger.log("ckpt/learner_stall_ms", stall_ms)
+        self.recorder.record(
+            "checkpoint", step=self._learner_step,
+            stall_ms=round(stall_ms, 1),
         )
         return path
+
+    def _obs_run_start(self, target: int) -> None:
+        """Flight-recorder run header + SIGTERM flush hook (main thread
+        only — install_sigterm no-ops elsewhere)."""
+        if self._postmortem_dir:
+            self.recorder.install_sigterm(self._postmortem_dir)
+        self.recorder.record(
+            "run_start", target=target,
+            mode="fused" if self.fused is not None else "host",
+            actor_mode=self.cfg.actor.mode,
+        )
+        self.health.beat("learner")
+
+    def _obs_fault(self, e: BaseException) -> None:
+        """Fault path: one recorded event + a post-mortem dump.  Both are
+        best-effort by construction — a dump failure must never mask the
+        exception that brought us here."""
+        self.recorder.record("fault", error=f"{type(e).__name__}: {e}")
+        self.recorder.dump(self._postmortem_dir, "fault")
+
+    def _close_obs(self) -> None:
+        if self.obs_server is not None:
+            try:
+                self.obs_server.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            self.obs_server = None
+
+    def _obs_extra(self) -> dict:
+        """Per-worker shm stats + lineage on the SAME emit as learner
+        throughput — the fleet-wide record the ISSUE's analysis needs in
+        one place."""
+        out: dict = {}
+        pool = getattr(self.worker, "pool", None)
+        if pool is not None and hasattr(pool, "worker_stats"):
+            ws = pool.worker_stats()
+            if ws:
+                out["workers"] = ws
+        if self._lineage is not None and self._lineage.age_hist.count:
+            out["lineage"] = self._lineage.summary(include_recent=False)
+        return out
 
     def _transport_extra(self) -> dict:
         """Experience-transport metrics (process-actor shm rings): ingest
@@ -834,6 +1044,7 @@ class AsyncPipeline:
             final=final,
             **self._transport_extra(),
             **self._ckpt_extra(),
+            **self._obs_extra(),
         )
 
     def _place(self, host_batch):
@@ -903,4 +1114,5 @@ class AsyncPipeline:
             final=final,
             **self._transport_extra(),
             **self._ckpt_extra(),
+            **self._obs_extra(),
         )

@@ -50,6 +50,8 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from ape_x_dqn_tpu.obs.recorder import FlightRecorder, write_postmortem
+from ape_x_dqn_tpu.obs.shm_stats import WORKER_SLOTS, WorkerStatsBlock
 from ape_x_dqn_tpu.runtime.shm_ring import (
     DXP,
     XP,
@@ -232,7 +234,8 @@ def worker_slice(worker_id: int, num_actors: int, num_workers: int) -> tuple:
 
 def _cfg_from_dict(cfg_dict: dict):
     from ape_x_dqn_tpu.config import (
-        ActorConfig, ApexConfig, EnvConfig, LearnerConfig, ReplayConfig,
+        ActorConfig, ApexConfig, EnvConfig, LearnerConfig, ObsConfig,
+        ReplayConfig,
     )
 
     return ApexConfig(
@@ -240,6 +243,7 @@ def _cfg_from_dict(cfg_dict: dict):
         actor=ActorConfig(**cfg_dict["actor"]),
         learner=LearnerConfig(**cfg_dict["learner"]),
         replay=ReplayConfig(**cfg_dict["replay"]),
+        obs=ObsConfig(**cfg_dict.get("obs", {})),
         network=cfg_dict["network"],
         seed=cfg_dict["seed"],
     )
@@ -280,10 +284,13 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                  shm_name: str, shm_capacity: int, ring_name: str,
                  ring_capacity: int, ctl_queue, stop_evt,
                  steps_budget: int, quantum: int, attempt: int = 0,
-                 seed_base: int = 0, nice: int = 0):
+                 seed_base: int = 0, nice: int = 0,
+                 stats_name: Optional[str] = None):
     """Worker process entry: CPU-only jax, one ActorFleet slice, gather
     chunks into this incarnation's shm ring; episode stats / completion /
-    errors ride the low-volume control queue."""
+    errors ride the low-volume control queue.  Metrics ride the
+    incarnation's shm stats block (obs/shm_stats): slots + flight-recorder
+    events the parent can read even after a SIGKILL."""
     if nice:
         # QoS: on hosts where workers share cores with the learner, a
         # positive niceness keeps the learner's dispatch thread scheduled
@@ -313,6 +320,7 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
     _jax.config.update("jax_platforms", "cpu")
     buf = None
     ring = None
+    sblock = None
     try:
         from ape_x_dqn_tpu.actors import ActorFleet
         from ape_x_dqn_tpu.envs import make_env
@@ -355,6 +363,42 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
         buf = SharedParamBuffer(shm_capacity, name=shm_name, create=False)
         ring = ShmRing(ring_capacity, name=ring_name, create=False)
         source = SharedBufferParamSource(buf, template)
+        # Observability: the incarnation's shm stats block (parent-created;
+        # this worker is the single writer) + a flight recorder mirrored
+        # into its event ring.  Metrics must never kill a worker — any
+        # failure here degrades to "no stats", not an error.
+        if stats_name:
+            try:
+                sblock = WorkerStatsBlock(name=stats_name, create=False)
+            except Exception:  # noqa: BLE001 — degrade, don't die
+                sblock = None
+        recorder = FlightRecorder(
+            name=f"worker{worker_id}", depth=cfg.obs.recorder_depth,
+            shm_sink=sblock,
+        )
+        eps = np.asarray(fleet._epsilons)
+        if sblock is not None:
+            sblock.update(
+                eps_mean=float(eps.mean()), eps_min=float(eps.min()),
+                eps_max=float(eps.max()),
+            )
+        recorder.record(
+            "spawn", worker=worker_id, attempt=attempt, lo=lo, hi=hi,
+            budget=steps_budget,
+        )
+        # Lineage trace sampling (obs/lineage): a sampled chunk carries a
+        # random nonzero 63-bit id on the wire envelope.
+        import random as _random
+
+        trace_rng = _random.Random(
+            (os.getpid() << 20) ^ (worker_id << 8) ^ attempt
+        )
+        trace_rate = float(cfg.obs.trace_sample_rate)
+        chunks_sent = 0
+        transitions_sent = 0
+        episodes_total = 0
+        collect_s = 0.0
+        write_s = 0.0
         # Wait for the learner's first publication (the reference's
         # construct-learner-first ordering constraint, main.py:44).
         deadline = time.monotonic() + 60.0
@@ -366,11 +410,17 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
         while not stop_evt.is_set() and fleet.step_count < steps_budget:
             # Clamp the final quantum: the budget bounds TOTAL fleet steps
             # across incarnations, so the last collect must land exactly.
-            chunks, stats = fleet.collect(
+            t0 = time.monotonic()
+            chunks, ep_stats = fleet.collect(
                 min(quantum, steps_budget - fleet.step_count),
                 param_source=source,
             )
+            collect_s += time.monotonic() - t0
+            t0 = time.monotonic()
             for c in chunks:
+                trace_id = 0
+                if trace_rate and trace_rng.random() < trace_rate:
+                    trace_id = trace_rng.getrandbits(63) or 1
                 if cfg.replay.dedup:
                     # DedupChunk arrays ship as APXT buffers; the int
                     # identity fields ride the record's metadata prefix.
@@ -384,7 +434,7 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                                          "action", "reward", "discount")},
                         },
                         source=d["source"], chunk_seq=d["chunk_seq"],
-                        prev_frames=d["prev_frames"],
+                        prev_frames=d["prev_frames"], trace_id=trace_id,
                     )
                 else:
                     parts = encode_chunk_parts(
@@ -395,6 +445,7 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                                for f in ("obs", "action", "reward",
                                          "discount", "next_obs")},
                         },
+                        trace_id=trace_id,
                     )
                 # Backpressure: block on a full ring (bounded sleeps, the
                 # learner's drain frees space) but abort promptly on stop —
@@ -402,18 +453,47 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                 # there is no shared lock a kill could strand.
                 if not ring.write(parts, should_stop=stop_evt.is_set):
                     break
-            if stats:
+                chunks_sent += 1
+                transitions_sent += len(c.priorities)
+                if trace_id:
+                    recorder.record(
+                        "trace_chunk", trace_id=trace_id,
+                        rows=len(c.priorities), v=fleet.param_version,
+                    )
+            write_s += time.monotonic() - t0
+            if ep_stats:
+                episodes_total += len(ep_stats)
                 ctl_queue.put((
                     "episodes", worker_id,
                     [(s.actor_id + lo, s.episode_return, s.episode_length)
-                     for s in stats],
+                     for s in ep_stats],
                 ))
+            if sblock is not None:
+                # One batched slot write + heartbeat per quantum — the
+                # cadence the parent's poll sweep reads.
+                sblock.update(
+                    env_steps=fleet.step_count, chunks=chunks_sent,
+                    transitions=transitions_sent,
+                    param_version=fleet.param_version,
+                    episodes=episodes_total, collect_s=collect_s,
+                    write_s=write_s,
+                )
             # Arena hygiene each quantum: the obs-batch allocation stream
             # otherwise grows worker RSS ~0.65 MB/s forever (utils/memory
             # docstring — measured in the round-5 flagship soak).
             trim_malloc()
+        recorder.record("done", steps=fleet.step_count,
+                        stopped=stop_evt.is_set())
         ctl_queue.put(("done", worker_id, fleet.step_count))
     except Exception as e:  # noqa: BLE001 — report, don't hang the join
+        if sblock is not None:
+            try:  # last words into the SIGKILL-proof event ring
+                sblock.record_event({
+                    "t": round(time.monotonic(), 4), "kind": "error",
+                    "error": f"{type(e).__name__}: {e}",
+                })
+            except Exception:
+                pass
         try:
             ctl_queue.put(("error", worker_id, f"{type(e).__name__}: {e}"))
         except Exception:
@@ -423,6 +503,8 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
             buf.close()
         if ring is not None:
             ring.close()
+        if sblock is not None:
+            sblock.close()
 
 
 class ProcessActorPool:
@@ -440,7 +522,8 @@ class ProcessActorPool:
                  queue_size: int = 64, quantum: Optional[int] = None,
                  max_restarts: int = 3, seed_base: int = 0,
                  ring_bytes: Optional[int] = None,
-                 drain_budget_bytes: Optional[int] = None):
+                 drain_budget_bytes: Optional[int] = None,
+                 postmortem_dir: Optional[str] = None):
         import jax
 
         from ape_x_dqn_tpu.config import to_dict
@@ -499,6 +582,17 @@ class ProcessActorPool:
         self._dead_since: dict = {}           # wid -> first-seen-dead time
         self._salvaged: list = []             # chunks drained pre-respawn
         self._silent_death_grace_s = 10.0
+        # Observability: one shm stats block per worker incarnation (slots
+        # + flight-recorder event ring, readable after SIGKILL —
+        # obs/shm_stats); poll() sweeps them into a cached per-worker
+        # snapshot, and _salvage_incarnation turns a dead incarnation's
+        # block into a post-mortem record.
+        self._stats_blocks: dict = {}
+        self._stats_prev: dict = {}      # wid -> (t, env_steps, steps_s)
+        self._worker_snap: dict = {}
+        self._worker_snap_t = 0.0
+        self.postmortems: List[dict] = []
+        self._postmortem_dir = postmortem_dir
         # Per-host exploration component (multi-host SPMD: each host's
         # workers must not duplicate another host's streams).
         self._seed_base = int(seed_base)
@@ -510,13 +604,24 @@ class ProcessActorPool:
             self._salvage_incarnation(wid)
         self._queues[wid] = self._ctx.Queue(maxsize=self._queue_size)
         self._rings[wid] = ShmRing(self._ring_bytes)
+        self._stats_prev.pop(wid, None)  # fresh incarnation: rate resets
+        try:
+            self._stats_blocks[wid] = WorkerStatsBlock(
+                slots=WORKER_SLOTS,
+                event_depth=max(16, getattr(
+                    getattr(self.cfg, "obs", None), "recorder_depth", 64
+                )),
+            )
+            stats_name = self._stats_blocks[wid].name
+        except Exception:  # noqa: BLE001 — stats must not block a spawn
+            stats_name = None
         p = self._ctx.Process(
             target=_worker_main,
             args=(wid, self._cfg_dict, self.num_workers, self.buffer.name,
                   self.buffer.capacity, self._rings[wid].name,
                   self._ring_bytes, self._queues[wid], self.stop_event,
                   budget, self._quantum, attempt, self._seed_base,
-                  self.cfg.actor.worker_nice),
+                  self.cfg.actor.worker_nice, stats_name),
             daemon=True,
         )
         p.start()
@@ -530,6 +635,7 @@ class ProcessActorPool:
         respawn gets a fresh ring, so its stream restarts seq-clean."""
         self._drain_control(self._queues[wid])
         ring = self._rings.pop(wid, None)
+        ring_post: dict = {}
         if ring is not None:
             salvaged = 0
             while True:
@@ -538,10 +644,44 @@ class ProcessActorPool:
                     break
                 self._salvaged.append(self._decode_record(wid, rec))
                 salvaged += 1
-            self.transport.count_salvage(salvaged, torn=ring.torn_tail())
+            torn = ring.torn_tail()
+            self.transport.count_salvage(salvaged, torn=torn)
             self._full_waits_base += ring.full_waits
+            ring_post = {
+                "salvaged_records": salvaged,
+                "torn_tail": bool(torn),
+                "started": ring.started,
+                "committed": ring.committed,
+                "full_waits": ring.full_waits,
+            }
             ring.close()
             ring.unlink()
+        # The dead incarnation's shm stats block is the post-mortem: final
+        # slot values + the flight recorder's last events — readable even
+        # after SIGKILL (the whole reason the block lives in /dev/shm).
+        blk = self._stats_blocks.pop(wid, None)
+        post = {
+            "worker": wid,
+            "attempt": self._attempt.get(wid, 1) - 1,
+            "ring": ring_post,
+        }
+        if blk is not None:
+            try:
+                post["stats"] = blk.snapshot()
+                events, ev_torn = blk.recent_events()
+                post["events"] = events
+                post["events_torn"] = ev_torn
+            except Exception as e:  # noqa: BLE001 — salvage best-effort
+                post["stats_error"] = f"{type(e).__name__}: {e}"
+            blk.close()
+            blk.unlink()
+        self.postmortems.append(post)
+        if self._postmortem_dir:
+            path = write_postmortem(
+                self._postmortem_dir, f"worker{wid}", "salvage", post
+            )
+            if path:
+                post["path"] = path
         old = self._queues.pop(wid, None)
         if old is not None:
             try:  # release the pipe fds now, not at gc (256-worker budget)
@@ -570,12 +710,51 @@ class ProcessActorPool:
         except OSError:
             n_fds = -1
         return {
-            "shm_segments": 1 + len(self._rings),
+            "shm_segments": 1 + len(self._rings) + len(self._stats_blocks),
             "ring_bytes_each": self._ring_bytes,
             "ring_bytes_total": self._ring_bytes * len(self._rings),
             "param_buffer_bytes": self.buffer.capacity,
             "process_fds": n_fds,
         }
+
+    def worker_stats(self, max_age_s: float = 0.5) -> dict:
+        """Per-worker sweep of the shm stats blocks — env steps (+ a
+        parent-derived steps/s), ε-ladder slice, chunk accounting, param
+        version, heartbeat age, ring occupancy.  Cached for ``max_age_s``
+        so the poll-cadence sweep stays O(workers) struct reads, and keyed
+        by str(wid) for JSON stability on the /varz + emit surfaces."""
+        now = time.monotonic()
+        if self._worker_snap and now - self._worker_snap_t < max_age_s:
+            return self._worker_snap
+        out: dict = {}
+        for wid, blk in list(self._stats_blocks.items()):
+            try:
+                snap = blk.snapshot()
+            except Exception:  # noqa: BLE001 — a closing block mid-sweep
+                continue
+            ring = self._rings.get(wid)
+            if ring is not None:
+                snap["ring_backlog_bytes"] = max(
+                    0, ring.committed_bytes - ring.bytes_read
+                )
+                snap["ring_full_waits"] = ring.full_waits
+            prev = self._stats_prev.get(wid)
+            if prev is not None and now - prev[0] >= 0.2:
+                dt = now - prev[0]
+                rate = max(0.0, snap["env_steps"] - prev[1]) / dt
+                snap["env_steps_s"] = round(rate, 1)
+                self._stats_prev[wid] = (now, snap["env_steps"], rate)
+            elif prev is not None:
+                snap["env_steps_s"] = round(prev[2], 1)
+            else:
+                snap["env_steps_s"] = 0.0
+                self._stats_prev[wid] = (now, snap["env_steps"], 0.0)
+            p = self._procs[wid] if wid < len(self._procs) else None
+            snap["alive"] = bool(p.is_alive()) if p is not None else False
+            out[str(wid)] = snap
+        self._worker_snap = out
+        self._worker_snap_t = now
+        return out
 
     def start(self, stagger_s: Optional[float] = None):
         """Spawn all workers, optionally throttled (``stagger_s`` seconds
@@ -654,13 +833,19 @@ class ProcessActorPool:
         return len(self.finished_workers) + len(self.worker_errors) >= self.num_workers
 
     def poll(self, max_items: int = 64, timeout: float = 0.0,
-             max_bytes: Optional[int] = None) -> List[tuple]:
+             max_bytes: Optional[int] = None,
+             with_meta: bool = False) -> List[tuple]:
         """One batched sweep over every live worker's ring (bounded by
         ``max_items`` chunks and the byte drain budget) plus the control
-        queues; returns [(priorities, transitions), ...].  Episode stats /
-        completion / errors update pool state as a side effect."""
+        queues; returns [(priorities, transitions), ...] — or, with
+        ``with_meta``, [(priorities, transitions, meta), ...] where meta
+        carries the wire envelope's observability fields (worker id,
+        ``sent_t``, lineage ``trace_id``).  Episode stats / completion /
+        errors update pool state, and the worker stats blocks are swept
+        into the cached per-worker snapshot, as side effects."""
         import queue as queue_mod
 
+        self.worker_stats()  # throttled shm sweep rides the poll cadence
         out = list(self._salvaged)
         self._salvaged.clear()
         budget = max_bytes if max_bytes is not None else self._drain_budget
@@ -692,14 +877,17 @@ class ProcessActorPool:
                     time.sleep(min(0.01, timeout))
                     continue
                 break
-        return out
+        if with_meta:
+            return out
+        return [(prio, trans) for prio, trans, _ in out]
 
     def _decode_record(self, wid: int, payload: bytes) -> tuple:
-        """One ring record → (priorities, transitions) + pool accounting.
-        Arrays are zero-copy read-only views over the record's own buffer
-        (already out of the ring), handed straight to replay ingest."""
+        """One ring record → (priorities, transitions, meta) + pool
+        accounting.  Arrays are zero-copy read-only views over the
+        record's own buffer (already out of the ring), handed straight to
+        replay ingest; meta is the envelope's observability triple."""
         (kind, version, sent_t, steps, source, chunk_seq, prev_frames,
-         arrays) = decode_chunk(payload)
+         trace_id, arrays) = decode_chunk(payload)
         self.last_versions[wid] = version
         self.actor_steps += steps
         # Fleet steps = chunk rows / actors-in-worker; tracked so a
@@ -711,6 +899,7 @@ class ProcessActorPool:
         self.transport.record_chunk(
             len(payload), time.monotonic() - sent_t, steps
         )
+        meta = {"wid": wid, "sent_t": sent_t, "trace_id": trace_id}
         prio = arrays.pop("prio")
         if kind == DXP:
             from ape_x_dqn_tpu.types import DedupChunk
@@ -718,8 +907,8 @@ class ProcessActorPool:
             return (prio, DedupChunk(
                 source=source, chunk_seq=chunk_seq, prev_frames=prev_frames,
                 **arrays,
-            ))
-        return (prio, self._NStepTransition(**arrays))
+            ), meta)
+        return (prio, self._NStepTransition(**arrays), meta)
 
     def transport_stats(self) -> dict:
         """Experience-transport metrics snapshot: ingest bytes/s, chunk
@@ -786,6 +975,10 @@ class ProcessActorPool:
                 self._queues.pop(wid).close()
             except Exception:  # noqa: BLE001 — teardown best-effort
                 pass
+        for wid in list(self._stats_blocks):
+            blk = self._stats_blocks.pop(wid)
+            blk.close()
+            blk.unlink()
         self.buffer.close()
 
 
@@ -802,12 +995,17 @@ class ProcessActorWorker:
     """
 
     def __init__(self, pool: "ProcessActorPool", sink, logger=None, fps=None,
-                 stop_event: Optional[threading.Event] = None):
+                 stop_event: Optional[threading.Event] = None,
+                 lineage=None):
         from ape_x_dqn_tpu.actors import EpisodeStat
 
         self._EpisodeStat = EpisodeStat
         self.pool = pool
         self._sink = sink
+        # Experience-lineage hook (obs/lineage.LineageTracker): fed with
+        # the replay slots each chunk landed in (the host-replay sink
+        # returns them) plus the envelope's trace id / send time.
+        self._lineage = lineage
         self._logger = logger
         self._fps = fps
         self._stop = threading.Event()
@@ -854,11 +1052,20 @@ class ProcessActorWorker:
     def _pump(self):
         while not self._stop.is_set():
             self.pool.supervise()
-            items = self.pool.poll(max_items=64, timeout=0.05)
-            for prio, trans in items:
-                self._sink(prio, trans)
+            items = self.pool.poll(max_items=64, timeout=0.05,
+                                   with_meta=True)
+            for prio, trans, meta in items:
+                idx = self._sink(prio, trans)
                 if self._fps is not None:
                     self._fps.add(len(prio))
+                if self._lineage is not None and idx is not None:
+                    # Host-replay sinks return the slot indices written —
+                    # the lineage hand-off point (fused sinks return None:
+                    # HBM slots never surface to the host).
+                    self._lineage.on_ingest(
+                        idx, t_act=meta["sent_t"],
+                        trace_id=meta["trace_id"], wid=meta["wid"],
+                    )
             if items:
                 self.heartbeat = time.monotonic()
             if self.pool.episodes:

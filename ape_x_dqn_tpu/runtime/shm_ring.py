@@ -407,28 +407,31 @@ DXP = 2   # frame-dedup DedupChunk
 
 # kind u8 | pad | version i64 | sent_t f64 (CLOCK_MONOTONIC, comparable
 # across processes on one Linux host) | actor_steps i64 | source i64 |
-# chunk_seq i64 | prev_frames i64
-_MSG = struct.Struct("<B7xqdqqqq")
+# chunk_seq i64 | prev_frames i64 | trace_id i64 (0 = unsampled; a nonzero
+# id marks this chunk for experience-lineage tracing — obs/lineage.py
+# follows it actor → ring → ingest → sample → train)
+_MSG = struct.Struct("<B7xqdqqqqq")
 
 
 def encode_chunk_parts(kind: int, version: int, actor_steps: int,
                        arrays: Dict[str, np.ndarray], source: int = 0,
                        chunk_seq: int = 0, prev_frames: int = 0,
-                       sent_t: Optional[float] = None) -> List:
+                       sent_t: Optional[float] = None,
+                       trace_id: int = 0) -> List:
     """Ring-ready parts for one experience chunk (prefix + APXT parts)."""
     prefix = _MSG.pack(
         kind, int(version), sent_t if sent_t is not None else time.monotonic(),
         int(actor_steps), int(source), int(chunk_seq), int(prev_frames),
+        int(trace_id),
     )
     return [prefix, *pack_array_parts(arrays)]
 
 
 def decode_chunk(payload: bytes, copy: bool = False):
     """(kind, version, sent_t, actor_steps, source, chunk_seq, prev_frames,
-    arrays) from one ring record."""
-    kind, version, sent_t, actor_steps, source, chunk_seq, prev_frames = (
-        _MSG.unpack_from(payload, 0)
-    )
+    trace_id, arrays) from one ring record."""
+    (kind, version, sent_t, actor_steps, source, chunk_seq, prev_frames,
+     trace_id) = _MSG.unpack_from(payload, 0)
     arrays = unpack_arrays(memoryview(payload)[_MSG.size:], copy=copy)
     return (kind, version, sent_t, actor_steps, source, chunk_seq,
-            prev_frames, arrays)
+            prev_frames, trace_id, arrays)

@@ -68,6 +68,11 @@ def build_argparser() -> argparse.ArgumentParser:
     )
     p.add_argument("--metrics-file", default=None, help="also write JSONL here")
     p.add_argument("--metrics-every", type=float, default=2.0)
+    p.add_argument(
+        "--obs-port", type=int, default=None, metavar="PORT",
+        help="start the /metrics + /varz + /healthz exporter on this port "
+        "(0 = ephemeral; overrides config obs.export_port)",
+    )
     return p
 
 
@@ -125,6 +130,30 @@ def main(argv=None) -> int:
     )
     server.warmup(comps.obs_shape)
     server.start()
+
+    # Observability exporter over the serving tier (and, under --attach,
+    # the trainer's registry too — one scrape covers both halves).
+    obs_server = None
+    obs_port = args.obs_port if args.obs_port is not None \
+        else cfg.obs.export_port
+    if obs_port is not None:
+        from ape_x_dqn_tpu.obs import Health, MetricsRegistry, ObsServer
+
+        if pipe is not None:
+            registry, health = pipe.obs_registry, pipe.health
+            pipe._close_obs()  # serve.py's exporter owns the port here
+        else:
+            registry = MetricsRegistry()
+            health = Health(stale_after_s=cfg.obs.heartbeat_stale_s)
+        registry.register_provider("serving", server.stats)
+        health.register(
+            "serving_batcher",
+            lambda: time.monotonic() - server._batcher.heartbeat,
+        )
+        obs_server = ObsServer(registry, health, port=obs_port)
+        logger.event("obs_exporter", port=obs_server.port,
+                     url=obs_server.url)
+
     if trainer_thread is not None:
         trainer_thread.start()
 
@@ -156,6 +185,8 @@ def main(argv=None) -> int:
         if trainer_thread is not None and trainer_thread.is_alive():
             trainer_thread.join(timeout=30.0)
         server.emit_metrics(logger, final=True)
+        if obs_server is not None:
+            obs_server.close()
         server.close()
         logger.close()
     return 0 if not errors else 1

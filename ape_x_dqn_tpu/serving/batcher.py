@@ -120,6 +120,10 @@ class MicroBatcher:
         self.error_count = 0
         self.batch_hist: dict[int, int] = {}   # real batch size -> count
         self._started = False
+        # Liveness for /healthz (obs.Health age fn): the worker loop
+        # stamps this every iteration — including idle ones — so a stale
+        # heartbeat means the batcher thread is wedged, not just unloaded.
+        self.heartbeat = time.monotonic()
 
     # -- client side ------------------------------------------------------
 
@@ -190,6 +194,7 @@ class MicroBatcher:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            self.heartbeat = time.monotonic()
             try:
                 first = self._q.get(timeout=0.05)
             except queue.Empty:

@@ -13,11 +13,39 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import sys
 import threading
 import time
 from collections import defaultdict, deque
 from typing import IO, Dict, Optional
+
+# ---------------------------------------------------------------------------
+# Record stamping: every JSONL record carries (pid, seq).  Multi-process runs
+# merge many streams (learner, workers, tools) into one file, and wall clocks
+# alone cannot order them — pids collide across time but (pid, seq) is a
+# strict total order WITHIN each process, which is exactly what a
+# deterministic merge needs (sort by pid, then seq; docs/METRICS.md).
+# ---------------------------------------------------------------------------
+
+_seq_lock = threading.Lock()
+_seq = 0
+
+
+def _next_seq() -> int:
+    global _seq
+    with _seq_lock:
+        _seq += 1
+        return _seq
+
+
+def stamp_record(record: dict) -> dict:
+    """Stamp ``seq`` (per-process monotone) and ``pid`` onto a record
+    in-place (existing values win: re-emitting a merged stream must not
+    restamp).  Every emit path in this module calls this."""
+    record.setdefault("seq", _next_seq())
+    record.setdefault("pid", os.getpid())
+    return record
 
 
 class RateCounter:
@@ -55,9 +83,25 @@ class RateCounter:
             # Fixed-window denominator (clamped to the counter's age):
             # dividing by the first-event-to-now span instead inflates the
             # rate arbitrarily for bursty arrivals — one 8k-transition
-            # chunk landing 0.5 s ago would read as 16k/s.
-            span = max(min(self._window, now - self._born), 1e-9)
+            # chunk landing 0.5 s ago would read as 16k/s.  The 1 ms floor
+            # bounds the clock-adjacent edge (an add() in the same tick as
+            # rate() — zero or sub-resolution interval) to a finite,
+            # non-absurd rate instead of count/1e-9.
+            span = max(min(self._window, now - self._born), 1e-3)
             return sum(n for _, n in self._events) / span
+
+    def merge(self, other: "RateCounter") -> None:
+        """Fold ``other``'s window into this counter (multi-pool / salvage
+        aggregation).  Events interleave by timestamp; totals add."""
+        with other._lock:
+            events = list(other._events)
+            total = other._total
+            born = other._born
+        with self._lock:
+            merged = sorted([*self._events, *events])
+            self._events = deque(merged)
+            self._total += total
+            self._born = min(self._born, born)
 
 
 class LatencyHistogram:
@@ -121,6 +165,46 @@ class LatencyHistogram:
                     return min(self._min * 10 ** (i / self._per), self._max)
             return self._max
 
+    def merge(self, other: "LatencyHistogram") -> None:
+        """Fold ``other`` into this histogram — bucket layouts must match
+        (same min_s / per_decade / bucket count), or percentiles would be
+        silently wrong."""
+        if (self._min, self._per, len(self._counts)) != (
+            other._min, other._per, len(other._counts)
+        ):
+            raise ValueError(
+                "cannot merge histograms with different bucket layouts"
+            )
+        with other._lock:
+            counts = list(other._counts)
+            count, total, mx = other._count, other._sum, other._max
+        with self._lock:
+            self._counts = [a + b for a, b in zip(self._counts, counts)]
+            self._count += count
+            self._sum += total
+            self._max = max(self._max, mx)
+
+    def buckets(self) -> dict:
+        """Non-empty buckets as {upper_edge_seconds: count} (plus
+        ``"+Inf"`` for overflow) — the raw distribution for /varz scrapes
+        and dashboard histograms, not just the percentile summary."""
+        with self._lock:
+            counts = list(self._counts)
+        out: dict = {}
+        last = len(counts) - 1
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            if i == 0:
+                edge = self._min
+            elif i == last:
+                out["+Inf"] = c
+                continue
+            else:
+                edge = self._min * 10 ** (i / self._per)
+            out[f"{edge:.6g}"] = c
+        return out
+
     def summary(self) -> dict:
         """{count, mean_ms, p50_ms, p95_ms, p99_ms, max_ms} snapshot."""
         with self._lock:
@@ -174,6 +258,20 @@ class TransportStats:
         if torn:
             self.torn_records += 1
 
+    def merge(self, other: "TransportStats") -> None:
+        """Fold another transport's stats into this one (multi-pool fleets,
+        post-salvage aggregation): window rates interleave, the latency
+        histogram merges bucket-wise, cumulative counters add."""
+        self.bytes_rate.merge(other.bytes_rate)
+        self.chunk_rate.merge(other.chunk_rate)
+        self.transition_rate.merge(other.transition_rate)
+        self.latency.merge(other.latency)
+        self.chunks += other.chunks
+        self.bytes += other.bytes
+        self.transitions += other.transitions
+        self.salvaged_records += other.salvaged_records
+        self.torn_records += other.torn_records
+
     def summary(self) -> dict:
         lat = self.latency.summary()
         return {
@@ -201,7 +299,7 @@ def emit_event(event: str, stream: Optional[IO] = None, **fields) -> dict:
     (stderr default — stdout belongs to the run's metric records), never a
     bare ``print``.  Returns the record so callers can also log/assert it.
     """
-    record = {"event": event, **fields}
+    record = stamp_record({"event": event, **fields})
     out = stream if stream is not None else sys.stderr
     try:
         out.write(json.dumps(record) + "\n")
@@ -255,7 +353,7 @@ class MetricLogger:
     def event(self, name: str, **fields) -> dict:
         """Immediate structured event record on every stream (see class
         docstring) — accumulators are untouched."""
-        record = {"event": name, **fields}
+        record = stamp_record({"event": name, **fields})
         line = json.dumps(record)
         with self._lock:
             for s in self._streams:
@@ -281,6 +379,7 @@ class MetricLogger:
                     record[f"{name}/n"] = len(vals)
             self._acc.clear()
         record.update(extra)
+        stamp_record(record)
         line = json.dumps(record)
         for s in self._streams:
             try:
@@ -291,7 +390,9 @@ class MetricLogger:
         if self._tb is not None:
             step = int(record.get("step", 0))
             for k, v in record.items():
-                if isinstance(v, (int, float)) and k not in ("step", "final"):
+                if isinstance(v, (int, float)) and k not in (
+                    "step", "final", "seq", "pid"
+                ):
                     self._tb.add_scalar(k, v, global_step=step)
         return record
 

@@ -619,7 +619,10 @@ class TestPipelineIntegration:
         log = MetricLogger(stream=buf)
         log.log("a", 1.0)
         rec = log.event("salvage", worker=3)
-        assert rec == {"event": "salvage", "worker": 3}
+        # Payload plus the universal (seq, pid) merge stamps — the
+        # multi-process ordering contract (docs/METRICS.md).
+        assert rec["event"] == "salvage" and rec["worker"] == 3
+        assert set(rec) == {"event", "worker", "seq", "pid"}
         lines = [json.loads(line) for line in buf.getvalue().splitlines()]
         assert rec in lines                      # written immediately
         assert log.emit()["a"] == 1.0            # accumulator survived

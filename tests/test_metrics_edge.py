@@ -1,0 +1,139 @@
+"""utils/metrics edge cases + the (seq, pid) record-stamping contract
+(ISSUE 4 satellites: histogram/rate-counter corners, TransportStats
+merge, deterministic multi-process JSONL ordering)."""
+
+import io
+import json
+import math
+import os
+
+import numpy as np
+
+from ape_x_dqn_tpu.utils.metrics import (
+    LatencyHistogram,
+    MetricLogger,
+    RateCounter,
+    TransportStats,
+    emit_event,
+)
+
+
+class TestLatencyHistogramEdges:
+    def test_empty_percentiles_nan_and_summary_count_zero(self):
+        h = LatencyHistogram()
+        assert math.isnan(h.percentile(50))
+        assert math.isnan(h.percentile(99))
+        assert h.summary() == {"count": 0}
+        assert h.buckets() == {}
+
+    def test_single_sample_all_percentiles_clamp_to_it(self):
+        h = LatencyHistogram()
+        h.record(0.0123)
+        s = h.summary()
+        assert s["count"] == 1
+        # One sample: every percentile is that sample (clamped to max —
+        # the bucket's upper edge must not overstate a lone observation).
+        assert s["p50_ms"] == s["p99_ms"] == s["max_ms"] == 12.3
+        assert abs(s["mean_ms"] - 12.3) < 1e-9
+
+    def test_underflow_and_overflow_buckets(self):
+        h = LatencyHistogram(min_s=1e-3, max_s=1.0)
+        h.record(1e-9)     # below min_s — underflow bucket
+        h.record(1e9)      # way past max_s — overflow bucket
+        assert h.count == 2
+        assert h.percentile(1) <= 1e-3
+        assert "+Inf" in h.buckets()
+
+    def test_merge_sums_counts_and_rejects_layout_mismatch(self):
+        a, b = LatencyHistogram(), LatencyHistogram()
+        for v in (0.001, 0.01):
+            a.record(v)
+        for v in (0.1, 1.0, 10.0):
+            b.record(v)
+        a.merge(b)
+        assert a.count == 5
+        assert a.summary()["max_ms"] == 10_000.0
+        mismatched = LatencyHistogram(min_s=1e-3)
+        try:
+            a.merge(mismatched)
+            raise AssertionError("layout mismatch must raise")
+        except ValueError:
+            pass
+
+
+class TestRateCounterEdges:
+    def test_empty_rate_is_zero(self):
+        assert RateCounter().rate() == 0.0
+
+    def test_clock_adjacent_zero_interval_is_finite_and_bounded(self):
+        """An add() in the same tick as rate(): the old 1e-9 span floor
+        reported count/1e-9 ≈ 1e9 events/s for a single event — absurd.
+        The 1 ms floor bounds the transient to count/1e-3."""
+        c = RateCounter(window_s=10.0)
+        c.add(5)
+        r = c.rate()
+        assert math.isfinite(r)
+        assert 0.0 < r <= 5 / 1e-3 + 1e-6
+
+    def test_merge_interleaves_totals(self):
+        a, b = RateCounter(window_s=60.0), RateCounter(window_s=60.0)
+        a.add(2)
+        b.add(3)
+        a.merge(b)
+        assert a.total == 5.0
+        assert a.rate() > 0.0
+
+
+class TestTransportStatsMerge:
+    def test_merge_sums_counters_rates_and_latency(self):
+        a, b = TransportStats(), TransportStats()
+        a.record_chunk(1000, 0.01, 16)
+        a.count_salvage(3, torn=True)
+        b.record_chunk(2000, 0.02, 32)
+        b.record_chunk(4000, 0.04, 64)
+        b.count_salvage(1, torn=False)
+        a.merge(b)
+        s = a.summary()
+        assert s["chunks"] == 3
+        assert s["transitions"] == 112
+        assert s["salvaged_records"] == 4
+        assert s["torn_records"] == 1
+        assert a.latency.count == 3
+        assert a.bytes == 7000
+        # Window rates interleave — the merged rate sees all three chunks.
+        assert a.chunk_rate.total == 3.0
+
+
+class TestRecordStamping:
+    def test_emit_event_stamps_seq_and_pid(self):
+        buf = io.StringIO()
+        r1 = emit_event("x", stream=buf, a=1)
+        r2 = emit_event("y", stream=buf)
+        assert r1["pid"] == r2["pid"] == os.getpid()
+        assert r2["seq"] > r1["seq"] > 0
+        lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+        assert [line["seq"] for line in lines] == [r1["seq"], r2["seq"]]
+
+    def test_logger_emit_and_event_share_one_monotone_sequence(self):
+        buf = io.StringIO()
+        log = MetricLogger(stream=buf)
+        log.log("v", 1.0)
+        a = log.emit(step=1)
+        b = log.event("thing", detail=2)
+        c = log.emit(step=2)
+        seqs = [a["seq"], b["seq"], c["seq"]]
+        assert seqs == sorted(seqs) and len(set(seqs)) == 3
+        assert all(r["pid"] == os.getpid() for r in (a, b, c))
+
+    def test_existing_stamps_win(self):
+        """Re-emitting a merged stream must not restamp (the merge key
+        would be destroyed)."""
+        r = emit_event("x", stream=io.StringIO(), seq=777, pid=42)
+        assert r["seq"] == 777 and r["pid"] == 42
+
+    def test_numpy_values_do_not_break_stamping(self):
+        buf = io.StringIO()
+        log = MetricLogger(stream=buf)
+        log.log("v", float(np.float32(2.5)))
+        rec = log.emit()
+        assert "seq" in rec and "pid" in rec

@@ -1,0 +1,512 @@
+"""Observability layer tests (ISSUE 4): registry/exporter/health units,
+shm stats blocks under SIGKILL, flight recorder + post-mortems, lineage
+tracking, the METRICS.md schema contract, and the process-actor
+end-to-end pins (trace-ID'd spans with monotone timestamps; SIGKILL →
+salvaged stats block → post-mortem file — same spirit as
+tests/test_shm_ring.py)."""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.obs import (
+    FlightRecorder,
+    Health,
+    LineageTracker,
+    MetricsRegistry,
+    ObsServer,
+    WORKER_SLOTS,
+    WorkerStatsBlock,
+    write_postmortem,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestMetricsRegistry:
+    def test_typed_instruments_get_or_create_and_conflict(self):
+        r = MetricsRegistry()
+        c = r.counter("chunks")
+        assert r.counter("chunks") is c
+        c.inc(2)
+        assert c.value == 2.0
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("chunks")
+        with pytest.raises(ValueError, match="only go up"):
+            c.inc(-1)
+
+    def test_gauge_fn_and_histogram(self):
+        r = MetricsRegistry()
+        r.gauge("step").set_fn(lambda: 7)
+        h = r.histogram("lat")
+        h.observe(0.01)
+        snap = r.snapshot()
+        assert snap["step"] == 7.0
+        assert snap["lat"]["count"] == 1
+        assert snap["lat"]["buckets"]
+
+    def test_provider_failure_degrades_to_error_entry(self):
+        r = MetricsRegistry()
+        r.register_provider("bad", lambda: 1 / 0)
+        r.register_provider("good", lambda: {"x": 1})
+        snap = r.snapshot()
+        assert "ZeroDivisionError" in snap["bad"]["error"]
+        assert snap["good"] == {"x": 1}
+
+    def test_prometheus_text_covers_all_kinds(self):
+        r = MetricsRegistry(prefix="apex")
+        r.counter("served").inc(5)
+        r.gauge("depth").set(3)
+        r.histogram("lat").observe(0.02)
+        r.register_provider("xp", lambda: {"mb_s": 1.5, "w": {"0": 2}})
+        text = r.prometheus_text()
+        assert "apex_served_total 5" in text
+        assert "apex_depth 3" in text
+        assert 'apex_lat{quantile="0.99"}' in text
+        assert "apex_xp_mb_s 1.5" in text
+        assert "apex_xp_w_0 2" in text
+        # Names are sanitized — no slashes survive.
+        r.gauge("learner/loss").set(1)
+        assert "apex_learner_loss 1" in r.prometheus_text()
+
+
+class TestHealth:
+    def test_beat_then_stale(self):
+        h = Health(stale_after_s=0.05)
+        h.beat("learner")
+        assert h.status()["status"] == "ok"
+        time.sleep(0.08)
+        st = h.status()
+        assert st["status"] == "degraded"
+        assert not st["components"]["learner"]["ok"]
+
+    def test_age_fn_and_failure_is_degraded(self):
+        h = Health(stale_after_s=1.0)
+        h.register("pump", lambda: 0.1)
+        h.register("dead", lambda: 1 / 0)
+        st = h.status()
+        assert st["components"]["pump"]["ok"]
+        assert not st["components"]["dead"]["ok"]
+        assert st["status"] == "degraded"
+
+
+class TestObsServer:
+    def test_endpoints_and_trace_hook(self):
+        r = MetricsRegistry()
+        r.gauge("step").set(9)
+        h = Health(stale_after_s=60.0)
+        h.beat("learner")
+        calls = []
+
+        def hook(steps=None):
+            calls.append(steps)
+            return {"state": "capturing", "steps": steps}
+
+        srv = ObsServer(r, h, port=0, trace_hook=hook)
+        try:
+            base = srv.url
+            txt = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "apex_step 9" in txt
+            varz = json.load(urllib.request.urlopen(f"{base}/varz"))
+            assert varz["step"] == 9.0
+            hz = urllib.request.urlopen(f"{base}/healthz")
+            assert hz.status == 200
+            assert json.load(hz)["status"] == "ok"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/nope")
+            assert ei.value.code == 404
+            varz = json.load(
+                urllib.request.urlopen(f"{base}/varz?trace=1&steps=32")
+            )
+            assert varz["trace"]["state"] == "capturing"
+            assert calls == [32]
+        finally:
+            srv.close()
+
+    def test_healthz_503_when_degraded(self):
+        h = Health(stale_after_s=0.01)
+        h.beat("learner")
+        time.sleep(0.03)
+        srv = ObsServer(MetricsRegistry(), h, port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{srv.url}/healthz")
+            assert ei.value.code == 503
+            assert json.load(ei.value)["status"] == "degraded"
+        finally:
+            srv.close()
+
+
+class TestWorkerStatsBlock:
+    def test_slot_and_event_roundtrip_with_wrap(self):
+        b = WorkerStatsBlock(slots=WORKER_SLOTS, event_depth=4)
+        try:
+            w = WorkerStatsBlock(name=b.name, create=False)
+            w.update(env_steps=128, eps_mean=0.25)
+            for i in range(7):
+                w.record_event({"kind": "collect", "i": i})
+            snap = b.snapshot()
+            assert snap["env_steps"] == 128.0
+            assert snap["eps_mean"] == 0.25
+            assert snap["pid"] == os.getpid()
+            assert snap["heartbeat_age_s"] < 5.0
+            events, torn = b.recent_events()
+            # Depth 4: only the newest 4 survive the wrap, in order.
+            assert [e["i"] for e in events] == [3, 4, 5, 6]
+            assert torn == 0
+            w.close()
+        finally:
+            b.close()
+            b.unlink()
+
+    def test_torn_event_slot_is_counted_not_delivered(self):
+        b = WorkerStatsBlock(slots=("x",), event_depth=2)
+        try:
+            w = WorkerStatsBlock(name=b.name, create=False)
+            w.record_event({"kind": "good"})
+            w.record_event({"kind": "mangled"})
+            # Corrupt the newest slot's length word — the SIGKILL-mid-write
+            # shape (payload bytes without a coherent frame).
+            import struct
+
+            off = b._events_off + (1 % 2) * 256
+            struct.pack_into("<I", b._shm.buf, off, 3)  # truncates the JSON
+            events, torn = b.recent_events()
+            assert [e["kind"] for e in events] == ["good"]
+            assert torn == 1
+            w.close()
+        finally:
+            b.close()
+            b.unlink()
+
+    def test_sigkilled_writer_leaves_readable_block(self):
+        """The core SIGKILL property: a real writer process killed
+        mid-stream leaves final slot values + events the parent reads
+        afterwards.  The child is stdlib-only (no jax) so this is fast."""
+        b = WorkerStatsBlock(slots=WORKER_SLOTS, event_depth=32)
+        child = subprocess.Popen(
+            [sys.executable, "-c", f"""
+import sys, time
+sys.path.insert(0, {REPO!r})
+from ape_x_dqn_tpu.obs.shm_stats import WorkerStatsBlock
+w = WorkerStatsBlock(name={b.name!r}, create=False)
+i = 0
+while True:
+    i += 1
+    w.update(env_steps=i, chunks=i * 2)
+    w.record_event({{"kind": "tick", "i": i}})
+    time.sleep(0.002)
+"""],
+        )
+        try:
+            deadline = time.monotonic() + 30.0
+            while b.snapshot()["env_steps"] < 10 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            os.kill(child.pid, signal.SIGKILL)
+            child.wait(timeout=10.0)
+            snap = b.snapshot()
+            assert snap["env_steps"] >= 10
+            assert snap["chunks"] == 2 * snap["env_steps"]
+            events, torn = b.recent_events()
+            assert events, "no events salvaged after SIGKILL"
+            assert events[-1]["i"] == int(snap["events_written"])
+            assert torn <= 1  # at most the one slot the kill interrupted
+        finally:
+            if child.poll() is None:
+                child.kill()
+            b.close()
+            b.unlink()
+
+
+class TestFlightRecorder:
+    def test_record_bounds_and_dump_is_atomic_json(self, tmp_path):
+        rec = FlightRecorder("trainer", depth=3)
+        rec.add_snapshot_provider("state", lambda: {"x": 1})
+        rec.add_snapshot_provider("bad", lambda: 1 / 0)
+        for i in range(5):
+            rec.record("tick", i=i)
+        assert [e["i"] for e in rec.events()] == [2, 3, 4]
+        path = rec.dump(str(tmp_path), "fault", extra={"why": "test"})
+        assert path and os.path.exists(path)
+        assert not any(
+            f.endswith(".tmp") for f in os.listdir(tmp_path)
+        )
+        with open(path) as f:
+            data = json.load(f)
+        assert data["reason"] == "fault"
+        assert data["snapshots"]["state"] == {"x": 1}
+        assert "ZeroDivisionError" in data["snapshots"]["bad"]["error"]
+        assert [e["i"] for e in data["events"]] == [2, 3, 4]
+
+    def test_dump_disabled_and_never_raises(self):
+        rec = FlightRecorder()
+        assert rec.dump("", "fault") is None
+        assert rec.dump("/proc/definitely/not/writable", "fault") is None
+
+    def test_sigterm_install_refused_off_main_thread(self, tmp_path):
+        rec = FlightRecorder()
+        out = []
+        t = threading.Thread(
+            target=lambda: out.append(rec.install_sigterm(str(tmp_path)))
+        )
+        t.start()
+        t.join()
+        assert out == [False]
+
+    def test_sigterm_flushes_in_a_real_process(self, tmp_path):
+        """SIGTERM a process with the handler installed → a post-mortem
+        file lands before death (the trainer's graceful-kill path)."""
+        child = subprocess.Popen(
+            [sys.executable, "-c", f"""
+import sys, time
+sys.path.insert(0, {REPO!r})
+from ape_x_dqn_tpu.obs.recorder import FlightRecorder
+r = FlightRecorder("t")
+r.record("alive")
+assert r.install_sigterm({str(tmp_path)!r})
+print("ready", flush=True)
+time.sleep(60)
+"""],
+            stdout=subprocess.PIPE,
+        )
+        try:
+            assert child.stdout.readline().strip() == b"ready"
+            child.terminate()
+            rc = child.wait(timeout=15.0)
+            assert rc != 0  # died of the chained SIGTERM, after the dump
+            files = [f for f in os.listdir(tmp_path)
+                     if "sigterm" in f and f.endswith(".json")]
+            assert files, "no sigterm post-mortem written"
+            with open(os.path.join(tmp_path, files[0])) as f:
+                assert json.load(f)["events"][0]["kind"] == "alive"
+        finally:
+            if child.poll() is None:
+                child.kill()
+
+    def test_write_postmortem_helper(self, tmp_path):
+        path = write_postmortem(str(tmp_path), "worker3", "salvage",
+                                {"stats": {"env_steps": 9}})
+        with open(path) as f:
+            data = json.load(f)
+        assert data["name"] == "worker3"
+        assert data["stats"]["env_steps"] == 9
+
+
+class TestLineageTracker:
+    def test_full_span_monotone_and_emitted(self):
+        events = []
+        tr = LineageTracker(
+            64, emit=lambda name, **kw: events.append((name, kw))
+        )
+        idx = np.arange(8)
+        tr.on_ingest(idx, t_act=time.monotonic() - 0.01, trace_id=123,
+                     wid=2)
+        tr.on_sample(idx[:4])
+        tr.on_trained(idx[:4])
+        assert tr.completed_count == 1
+        name, span = events[0]
+        assert name == "lineage_span"
+        assert span["trace_id"] == 123 and span["wid"] == 2
+        ts = [span[k] for k in
+              ("t_act", "t_ingest", "t_first_sample", "t_trained")]
+        assert ts == sorted(ts)
+        assert span["act_to_trained_ms"] >= span["act_to_ingest_ms"]
+        # Slots are released — a later sample of them is not traced.
+        tr.on_sample(idx)
+        assert tr.completed_count == 1
+
+    def test_age_histogram_counts_untraced_samples(self):
+        tr = LineageTracker(32)
+        tr.on_ingest(np.arange(16))          # trace_id 0: age-only
+        tr.on_sample(np.arange(8))
+        assert tr.age_hist.count == 8
+        s = tr.summary()
+        assert s["age_at_sample"]["count"] == 8
+        assert s["traces_open"] == 0
+
+    def test_recycled_slot_abandons_open_trace(self):
+        tr = LineageTracker(8)
+        tr.on_ingest(np.arange(8), trace_id=7)
+        tr.on_ingest(np.arange(4))           # ring lapped half the slots
+        assert tr.abandoned_count == 1
+        assert tr.summary()["traces_open"] == 0
+
+
+def _doc_keys(section_header):
+    with open(os.path.join(REPO, "docs", "METRICS.md")) as f:
+        text = f.read()
+    section = text.split(section_header, 1)[1]
+    keys = []
+    for line in section.splitlines():
+        line = line.strip()
+        if line.startswith("- `"):
+            keys.append(line.split("`")[1])
+        elif line.startswith("## "):
+            break
+    return keys
+
+
+class TestMetricsDocSchema:
+    """docs/METRICS.md is a contract: the stamped-keys list and the
+    periodic core-key list must match real emitted records exactly."""
+
+    def test_stamp_keys_match_doc(self):
+        from ape_x_dqn_tpu.utils.metrics import emit_event
+
+        doc = _doc_keys("## Stamped on every record")
+        assert doc == ["seq", "pid"]
+        rec = emit_event("x", stream=io.StringIO())
+        assert set(doc) <= set(rec)
+
+    def test_periodic_core_keys_match_doc(self, tiny_thread_run):
+        doc = set(_doc_keys("## Periodic record core keys"))
+        assert doc, "doc section missing"
+        record = tiny_thread_run["final_record"]
+        missing = doc - set(record)
+        assert not missing, f"documented keys absent from emit: {missing}"
+        # And the stamps ride periodic records too.
+        assert {"seq", "pid"} <= set(record)
+
+
+@pytest.fixture(scope="module")
+def tiny_thread_run():
+    """One small thread-mode pipeline run shared by the schema + lineage
+    tests (chain MDP, mlp — seconds, not minutes)."""
+    from ape_x_dqn_tpu.config import ApexConfig
+    from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
+    from ape_x_dqn_tpu.utils.metrics import MetricLogger
+
+    cfg = ApexConfig()
+    cfg.network = "mlp"
+    cfg.env.name = "chain:6"
+    cfg.actor.num_actors = 4
+    cfg.actor.T = 100_000
+    cfg.actor.flush_every = 8
+    cfg.actor.sync_every = 16
+    cfg.learner.min_replay_mem_size = 256
+    cfg.learner.publish_every = 5
+    cfg.learner.total_steps = 80
+    cfg.learner.optimizer = "adam"
+    cfg.learner.learning_rate = 1e-3
+    cfg.replay.capacity = 4096
+    cfg.obs.trace_sample_rate = 1.0
+    cfg.validate()
+    buf = io.StringIO()
+    pipe = AsyncPipeline(cfg, logger=MetricLogger(stream=buf), log_every=40)
+    final = pipe.run(learner_steps=80, warmup_timeout=120.0)
+    lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+    return {"final_record": final, "lines": lines, "pipe": pipe}
+
+
+class TestThreadModeLineage:
+    def test_spans_complete_and_ride_the_stream(self, tiny_thread_run):
+        lines = tiny_thread_run["lines"]
+        spans = [r for r in lines if r.get("event") == "lineage_span"]
+        assert spans, "no lineage_span events on the JSONL stream"
+        for s in spans[:5]:
+            ts = [s[k] for k in
+                  ("t_act", "t_ingest", "t_first_sample", "t_trained")]
+            assert ts == sorted(ts)
+        assert all("seq" in r and "pid" in r for r in lines)
+        assert tiny_thread_run["final_record"].get("lineage", {}).get(
+            "age_at_sample", {}
+        ).get("count", 0) > 0
+
+
+class TestProcessModeObsEndToEnd:
+    def test_traced_process_chunk_spans_and_sigkill_postmortem(
+        self, tmp_path
+    ):
+        """The two ISSUE acceptance pins in one fleet run: (a) a trace-ID'd
+        chunk from a REAL worker process is observed at ingest, sample,
+        and train with monotone spans on the JSONL stream; (b) a SIGKILLed
+        worker's shm stats block is salvaged into a post-mortem file.
+        (Also exercised CI-side by tools/obs_smoke.py, which verify_t1.sh
+        runs on every gate pass — this is the in-suite pin.)"""
+        from ape_x_dqn_tpu.config import ApexConfig
+        from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
+        from ape_x_dqn_tpu.utils.metrics import MetricLogger
+
+        cfg = ApexConfig()
+        cfg.network = "mlp"
+        cfg.env.name = "chain:6"
+        cfg.actor.mode = "process"
+        cfg.actor.num_workers = 1  # one spawn: the costly part of the test
+        cfg.actor.num_actors = 2
+        cfg.actor.T = 10_000_000
+        cfg.actor.flush_every = 8
+        cfg.actor.sync_every = 32
+        cfg.learner.min_replay_mem_size = 256
+        cfg.learner.publish_every = 10
+        cfg.learner.total_steps = 10**9
+        cfg.learner.optimizer = "adam"
+        cfg.replay.capacity = 8192
+        cfg.obs.trace_sample_rate = 1.0
+        cfg.obs.postmortem_dir = str(tmp_path / "postmortem")
+        cfg.validate()
+        buf = io.StringIO()
+        pipe = AsyncPipeline(
+            cfg, logger=MetricLogger(stream=buf), log_every=100
+        )
+        err = []
+
+        def run():
+            try:
+                pipe.run(warmup_timeout=300.0)
+            except Exception as e:  # noqa: BLE001
+                err.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 420.0
+            # (a) spans complete from real process-actor chunks.
+            while pipe._lineage.completed_count == 0 \
+                    and time.monotonic() < deadline:
+                assert not err, err
+                time.sleep(0.2)
+            assert pipe._lineage.completed_count > 0, "no spans completed"
+            # (b) SIGKILL one worker → salvage → post-mortem file.
+            pool = pipe.worker.pool
+            os.kill(pool._procs[0].pid, signal.SIGKILL)
+            pm_dir = cfg.obs.postmortem_dir
+            while time.monotonic() < deadline:
+                if os.path.isdir(pm_dir) and any(
+                    f.endswith(".json") for f in os.listdir(pm_dir)
+                ):
+                    break
+                time.sleep(0.2)
+            files = [f for f in os.listdir(pm_dir) if f.endswith(".json")]
+            assert files, "no post-mortem after SIGKILL"
+            with open(os.path.join(pm_dir, files[0])) as f:
+                pm = json.load(f)
+            assert pm["reason"] == "salvage"
+            assert pm["stats"]["env_steps"] > 0
+            assert pm["events"], "flight-recorder events not salvaged"
+        finally:
+            pipe.stop_event.set()
+            t.join(timeout=120.0)
+        assert not err, err
+        spans = [
+            json.loads(line) for line in buf.getvalue().splitlines()
+            if '"lineage_span"' in line
+        ]
+        assert spans
+        s = spans[0]
+        assert s["wid"] is not None  # produced by a real worker process
+        ts = [s[k] for k in
+              ("t_act", "t_ingest", "t_first_sample", "t_trained")]
+        assert ts == sorted(ts)
+        # act→ingest crossed a process boundary: strictly positive.
+        assert s["t_ingest"] > s["t_act"]

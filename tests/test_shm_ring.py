@@ -174,14 +174,18 @@ class TestWireFormat:
             "frames": np.zeros((5, 2, 2, 1), np.uint8),
         }
         parts = encode_chunk_parts(DXP, 42, 4, arrays, source=3,
-                                   chunk_seq=17, prev_frames=9)
+                                   chunk_seq=17, prev_frames=9,
+                                   trace_id=0x5EED)
         payload = b"".join(
             bytes(memoryview(p).cast("B")) if not isinstance(p, bytes)
             else p
             for p in parts
         )
-        kind, ver, sent_t, steps, src, cs, pf, back = decode_chunk(payload)
+        kind, ver, sent_t, steps, src, cs, pf, tid, back = (
+            decode_chunk(payload)
+        )
         assert (kind, ver, steps, src, cs, pf) == (DXP, 42, 4, 3, 17, 9)
+        assert tid == 0x5EED
         assert sent_t > 0
         for k, v in arrays.items():
             np.testing.assert_array_equal(back[k], v)
@@ -194,9 +198,10 @@ class TestWireFormat:
                 "obs": np.ones((3, 4, 4, 1), np.uint8),
             }
             assert writer.try_write(encode_chunk_parts(XP, 1, 3, arrays))
-            kind, ver, _, steps, _, _, _, back = decode_chunk(
+            kind, ver, _, steps, _, _, _, tid, back = decode_chunk(
                 reader.read_next()
             )
+            assert tid == 0  # unsampled default
             assert (kind, ver, steps) == (XP, 1, 3)
             np.testing.assert_array_equal(back["obs"], arrays["obs"])
         finally:

@@ -1,0 +1,197 @@
+"""Observability smoke gate (tools/verify_t1.sh gate 4).
+
+One CI-sized pass over the whole obs surface, on the REAL process-actor
+pipeline:
+
+  1. start the async pipeline (process actors, host replay) with the
+     exporter on an ephemeral port and lineage tracing at 100%;
+  2. scrape ``/metrics`` (Prometheus text), ``/varz`` (JSON: learner +
+     per-worker shm stats), and ``/healthz`` (must be ok while alive);
+  3. SIGKILL one worker mid-run and assert the parent salvages its shm
+     stats block into a post-mortem FILE (the SIGKILL-proof flight
+     recorder's end-to-end contract);
+  4. assert at least one lineage span completed (actor → ingest →
+     sample → train) with monotone timestamps;
+  5. stop cleanly; print a one-line JSON verdict.
+
+``--snapshot-out FILE`` additionally saves the final /varz scrape with
+the rendered obs_top frame — how ``demos/obs_top.json`` is produced.
+
+    python tools/obs_smoke.py
+    python tools/obs_smoke.py --seconds 30 --snapshot-out demos/obs_top.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def scrape(port: int, path: str, timeout: float = 5.0):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as r:
+        body = r.read()
+    return r.status, body
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="obs_smoke")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--seconds", type=float, default=0.0,
+                    help="extra run time after the checks pass (bigger "
+                    "snapshots for the committed artifact)")
+    ap.add_argument("--deadline", type=float, default=420.0)
+    ap.add_argument("--snapshot-out", default=None)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ape_x_dqn_tpu.config import ApexConfig
+    from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
+    from ape_x_dqn_tpu.utils.metrics import MetricLogger
+
+    cfg = ApexConfig()
+    cfg.network = "mlp"
+    cfg.env.name = "chain:6"
+    cfg.actor.mode = "process"
+    cfg.actor.num_workers = args.workers
+    cfg.actor.num_actors = 2 * args.workers
+    cfg.actor.T = 10_000_000
+    cfg.actor.flush_every = 8
+    cfg.actor.sync_every = 32
+    cfg.learner.min_replay_mem_size = 256
+    cfg.learner.publish_every = 10
+    cfg.learner.total_steps = 10**9
+    cfg.learner.optimizer = "adam"
+    cfg.learner.learning_rate = 1e-3
+    cfg.replay.capacity = 8192
+    cfg.obs.export_port = 0              # ephemeral — the gate's port
+    cfg.obs.trace_sample_rate = 1.0
+    pm_dir = tempfile.mkdtemp(prefix="obs_smoke_pm_")
+    cfg.obs.postmortem_dir = pm_dir
+    cfg.validate()
+
+    logger = MetricLogger(stream=open(os.devnull, "w"))
+    pipe = AsyncPipeline(cfg, logger=logger, log_every=200)
+    port = pipe.obs_port
+    assert port, "exporter did not bind"
+    verdict: dict = {"port": port, "postmortem_dir": pm_dir}
+    err: list = []
+    t = threading.Thread(
+        target=lambda: _run(pipe, err), name="smoke-trainer", daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + args.deadline
+    try:
+        # -- 2: endpoints up, learner making progress ----------------------
+        varz = None
+        while time.monotonic() < deadline:
+            if err:
+                raise RuntimeError(f"pipeline died early: {err[0]}")
+            try:
+                _, body = scrape(port, "/varz")
+                varz = json.loads(body)
+                if (varz.get("learner", {}).get("step", 0) > 0
+                        and varz.get("workers")):
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.5)
+        assert varz and varz["learner"]["step"] > 0, "learner never stepped"
+        assert len(varz["workers"]) == args.workers, (
+            f"expected {args.workers} worker stat blocks, "
+            f"got {list(varz.get('workers', {}))}"
+        )
+        code, text = scrape(port, "/metrics")
+        assert code == 200 and b"apex_learner_step" in text, (
+            "/metrics missing learner series"
+        )
+        code, hz = scrape(port, "/healthz")
+        hz = json.loads(hz)
+        assert code == 200 and hz["status"] == "ok", f"unhealthy: {hz}"
+        assert {"learner", "ingest"} <= set(hz["components"]), hz
+        verdict["healthz"] = hz
+        verdict["step_at_check"] = varz["learner"]["step"]
+
+        # -- 3: SIGKILL a worker, expect a post-mortem file ----------------
+        pool = pipe.worker.pool
+        victim = pool._procs[0]
+        os.kill(victim.pid, signal.SIGKILL)
+        while time.monotonic() < deadline:
+            if any(f.endswith(".json") for f in os.listdir(pm_dir)):
+                break
+            time.sleep(0.5)
+        pm_files = [f for f in os.listdir(pm_dir) if f.endswith(".json")]
+        assert pm_files, "no post-mortem file after SIGKILL"
+        with open(os.path.join(pm_dir, pm_files[0])) as f:
+            pm = json.load(f)
+        assert pm["reason"] == "salvage" and "stats" in pm, pm.keys()
+        verdict["postmortem"] = {
+            "file": pm_files[0],
+            "env_steps": pm["stats"].get("env_steps"),
+            "events": len(pm.get("events", [])),
+        }
+
+        # -- 4: lineage spans completed ------------------------------------
+        spans = 0
+        while time.monotonic() < deadline:
+            _, body = scrape(port, "/varz")
+            varz = json.loads(body)
+            spans = varz.get("lineage", {}).get("traces_completed", 0)
+            if spans > 0:
+                break
+            time.sleep(0.5)
+        assert spans > 0, "no lineage span completed"
+        recent = varz["lineage"].get("recent_spans") or []
+        for s in recent[:1]:
+            ts = [s["t_act"], s["t_ingest"], s["t_first_sample"],
+                  s["t_trained"]]
+            assert ts == sorted(ts), f"non-monotone span: {s}"
+        verdict["lineage_spans"] = spans
+
+        if args.seconds:
+            time.sleep(args.seconds)
+        if args.snapshot_out:
+            _, body = scrape(port, "/varz")
+            snap = json.loads(body)
+            from obs_top import render  # tools/ sibling
+
+            with open(args.snapshot_out, "w") as f:
+                json.dump(
+                    {"snapshot": snap,
+                     "rendered": render(snap).splitlines()},
+                    f, indent=1,
+                )
+            verdict["snapshot_out"] = args.snapshot_out
+        verdict["ok"] = True
+    finally:
+        pipe.stop_event.set()
+        t.join(timeout=120.0)
+    if err:
+        # The worker SIGKILL is survivable (respawn); anything else is not.
+        verdict["run_error"] = err[0]
+    print(json.dumps(verdict))
+    return 0 if verdict.get("ok") else 1
+
+
+def _run(pipe, err: list) -> None:
+    try:
+        pipe.run(warmup_timeout=300.0)
+    except Exception as e:  # noqa: BLE001 — surfaced in the verdict
+        err.append(f"{type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

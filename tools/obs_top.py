@@ -1,0 +1,203 @@
+"""obs_top — a live text dashboard over the observability layer.
+
+Two data sources, one renderer:
+
+  * ``--varz URL``  — scrape a running exporter's /varz (the trainer's
+    ``obs.export_port`` or serve.py's ``--obs-port``) on an interval;
+  * ``--jsonl PATH`` — tail a metrics JSONL file (a live run's
+    ``--metrics-file``, or a committed demo artifact) and render its
+    newest periodic record.
+
+Shows the fleet in one screen: learner throughput, per-worker actor
+stats (env-steps/s, ε slice, ring backlog, heartbeat age — the shm
+stats-block sweep), transport rates, and the true age-of-experience
+histogram at sample time (obs/lineage).  ``--once`` prints a single
+frame and exits; ``--snapshot-out FILE`` additionally writes the raw
+snapshot + rendered frame as JSON (how ``demos/obs_top.json`` is made).
+
+Stdlib only — this must run on any host that can reach the port.
+
+    python tools/obs_top.py --varz http://127.0.0.1:8080 --interval 2
+    python tools/obs_top.py --jsonl demos/longrun_metrics.jsonl --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def snapshot_from_varz(url: str, timeout: float = 5.0) -> dict:
+    """One /varz scrape, normalized (the exporter already emits the
+    sectioned layout the renderer wants)."""
+    base = url.rstrip("/")
+    if not base.endswith("/varz"):
+        base += "/varz"
+    with urllib.request.urlopen(base, timeout=timeout) as r:
+        return json.load(r)
+
+
+def snapshot_from_jsonl(path: str) -> dict:
+    """The newest periodic record of a metrics JSONL stream, lifted into
+    the /varz sectioned shape (top-level learner scalars → ``learner``;
+    ``workers`` / ``lineage`` / ``xp_transport`` ride through)."""
+    last = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail of a live file
+            if "step" in rec and "event" not in rec:
+                last = rec
+    if last is None:
+        raise ValueError(f"no periodic records in {path}")
+    learner_keys = (
+        "step", "steps_per_sec", "actor_fps", "actor_steps",
+        "param_version", "actor_restarts", "actor_heartbeat_age",
+        "replay_size",
+    )
+    out = {"learner": {k: last[k] for k in learner_keys if k in last}}
+    for section in ("workers", "lineage", "xp_transport", "ckpt",
+                    "stage_us"):
+        if section in last:
+            out[section] = last[section]
+    out["t"] = last.get("t")
+    return out
+
+
+def _bar(count: int, peak: int, width: int = 30) -> str:
+    n = 0 if peak <= 0 else max(1, round(count / peak * width))
+    return "#" * min(n, width)
+
+
+def _fmt_age(edge: str) -> str:
+    if edge == "+Inf":
+        return "   +Inf"
+    return f"{float(edge):7.3g}"
+
+
+def render(snap: dict) -> str:
+    """One dashboard frame (plain text) from a /varz-shaped snapshot."""
+    lines = []
+    ln = snap.get("learner", {})
+    lines.append(
+        "== apex-tpu obs_top ==  "
+        f"step {ln.get('step', '?')}  "
+        f"learner {ln.get('steps_per_sec', 0):>8} steps/s  "
+        f"actors {ln.get('actor_fps', 0):>8} fps  "
+        f"replay {ln.get('replay_size', '?')}  "
+        f"v{ln.get('param_version', '?')}"
+    )
+    workers = snap.get("workers") or {}
+    if workers:
+        lines.append(
+            f"-- workers ({len(workers)}) "
+            "----------------------------------------------------------"
+        )
+        lines.append(
+            " wid   alive  steps/s   env_steps  chunks      eps"
+            "[min..max]    ring_kB  hb_age"
+        )
+        for wid in sorted(workers, key=lambda w: int(w)):
+            w = workers[wid]
+            lines.append(
+                f"{wid:>4}   {'yes' if w.get('alive') else ' NO':<5}"
+                f"{w.get('env_steps_s', 0):>9.1f}"
+                f"{int(w.get('env_steps', 0)):>12}"
+                f"{int(w.get('chunks', 0)):>8}"
+                f"   {w.get('eps_mean', 0):.3f}"
+                f"[{w.get('eps_min', 0):.3f}..{w.get('eps_max', 0):.3f}]"
+                f"{w.get('ring_backlog_bytes', 0) / 1e3:>9.1f}"
+                f"{w.get('heartbeat_age_s', 0):>8.2f}"
+            )
+    xp = snap.get("xp_transport")
+    if xp:
+        lines.append(
+            f"-- transport: {xp.get('ingest_mb_s', 0)} MB/s  "
+            f"{xp.get('transitions_s', 0)} transitions/s  "
+            f"chunks {xp.get('chunks', 0)}  "
+            f"salvaged {xp.get('salvaged_records', 0)}  "
+            f"torn {xp.get('torn_records', 0)}  "
+            f"full_waits {xp.get('ring_full_waits', 0)}"
+        )
+    lineage = snap.get("lineage") or {}
+    age = lineage.get("age_at_sample") or {}
+    buckets = age.get("buckets_s") or age.get("buckets") or {}
+    if buckets:
+        lines.append(
+            f"-- age of experience at sample (s): "
+            f"n={age.get('count', 0)} p50={age.get('p50_ms', 0) / 1e3:.2f} "
+            f"p99={age.get('p99_ms', 0) / 1e3:.2f} "
+            f"max={age.get('max_ms', 0) / 1e3:.2f}"
+        )
+        peak = max(buckets.values())
+        for edge, count in buckets.items():
+            lines.append(
+                f"  <= {_fmt_age(edge)}s {count:>8}  {_bar(count, peak)}"
+            )
+        lines.append(
+            f"-- lineage: {lineage.get('traces_completed', 0)} spans done, "
+            f"{lineage.get('traces_open', 0)} open, "
+            f"{lineage.get('traces_abandoned', 0)} abandoned"
+        )
+    ckpt = snap.get("ckpt")
+    if ckpt:
+        lines.append(
+            f"-- ckpt: {ckpt.get('saves', 0)} saves "
+            f"({ckpt.get('bases', 0)} bases) "
+            f"last_stall {ckpt.get('last_stall_ms', 0)} ms  "
+            f"skips {ckpt.get('inflight_skips', 0)}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="obs_top")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--varz", metavar="URL",
+                     help="exporter base URL or full /varz URL")
+    src.add_argument("--jsonl", metavar="PATH",
+                     help="metrics JSONL file to tail")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    ap.add_argument("--plain", action="store_true",
+                    help="no ANSI clear between frames")
+    ap.add_argument("--snapshot-out", default=None, metavar="FILE",
+                    help="also write {snapshot, rendered} JSON here")
+    args = ap.parse_args(argv)
+
+    def grab() -> dict:
+        if args.varz:
+            return snapshot_from_varz(args.varz)
+        return snapshot_from_jsonl(args.jsonl)
+
+    while True:
+        try:
+            snap = grab()
+            frame = render(snap)
+        except Exception as e:  # noqa: BLE001 — a scrape gap, keep going
+            snap, frame = {}, f"(no data: {type(e).__name__}: {e})"
+        if not args.plain and not args.once:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(frame, flush=True)
+        if args.snapshot_out and snap:
+            with open(args.snapshot_out, "w") as f:
+                json.dump(
+                    {"snapshot": snap, "rendered": frame.splitlines()},
+                    f, indent=1,
+                )
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
