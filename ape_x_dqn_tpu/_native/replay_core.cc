@@ -20,11 +20,13 @@
 // q_i = (m_i / M_s) / K — with the IS weights computed for that realized
 // law (replay/device.py:137-145 is the same correction on TPU shards),
 // so a run can move between host stripes and device shards without
-// changing the estimator.  NOTE: the Python wrapper currently serializes
-// calls under one lock (its carry-resolver state is Python-side), so the
-// per-stripe mutexes are lock-granularity groundwork, not realized
-// multicore parallelism — this 1-core image could not demonstrate it
-// either way; bench sections label the striped numbers accordingly.
+// changing the estimator.  At n_stripes > 1 the Python wrapper fans each
+// sample/update out as one rc_sample_stripe / rc_update_stripe call PER
+// STRIPE through a persistent thread pool — ctypes releases the GIL, so
+// the stripe calls genuinely overlap in wall-clock on multicore hosts
+// (tests/test_native_dedup.py pins the overlap; the BENCH_r06 note about
+// the wrapper serializing striped calls is fixed).  Add/import still
+// serialize under the wrapper lock (carry-resolver state is Python-side).
 // n_stripes=1 reduces bit-for-bit to the numpy DedupReplay (the oracle:
 // tests/test_native_dedup.py).
 //
@@ -311,6 +313,84 @@ void rc_update(void* h, int64_t n, const int64_t* idx, const float* prio) {
                         c->alpha);
     Stripe& s = c->stripes[stripe_of(*c, slot)];
     std::lock_guard<std::mutex> g(s.mu);
+    tree_set_one(s, leaf_of(*c, slot), p);
+  }
+}
+
+// Per-stripe half of rc_sample, for the wrapper's PARALLEL fan-out
+// (replay/native_dedup.py dispatches one call per stripe through a
+// persistent thread pool; ctypes releases the GIL so stripe calls overlap
+// in wall-clock — the BENCH_r06 "striped4 wrapper serializes calls"
+// defect, fixed).  Samples Bk rows from stripe `s_i` using u[0..Bk) and
+// writes RAW (unnormalized) IS weights — the caller normalizes by the max
+// across ALL stripes, reproducing rc_sample's arithmetic bit-for-bit.
+// The gather runs outside the stripe lock, like rc_sample's (the Python
+// wrapper's lock excludes add/import during sampling).
+// Returns 0 ok, -1 empty stripe, -3 bad stripe id.
+int32_t rc_sample_stripe(void* h, int32_t s_i, int64_t Bk, double beta,
+                         const double* u, int64_t* out_idx,
+                         double* out_weights, uint8_t* out_obs,
+                         uint8_t* out_next, int32_t* out_action,
+                         float* out_reward, float* out_discount) {
+  Core* c = static_cast<Core*>(h);
+  if (s_i < 0 || s_i >= c->n_stripes) return -3;
+  int64_t size = std::min(c->count, c->capacity);
+  if (size == 0) return -1;
+  Stripe& s = c->stripes[s_i];
+  {
+    std::lock_guard<std::mutex> g(s.mu);
+    double total = s.tree[1];
+    if (total <= 0) return -1;
+    double bounds = total / Bk;
+    double clip = std::nextafter(total, 0.0);
+    for (int64_t j = 0; j < Bk; ++j) {
+      double target = (j + u[j]) * bounds;
+      target = std::min(std::max(target, 0.0), clip);
+      int64_t leaf = tree_descend(s, target);
+      int64_t slot = leaf * c->n_stripes + s_i;
+      if (slot >= c->capacity)
+        slot = c->capacity - 1 - ((c->capacity - 1 - s_i) % c->n_stripes);
+      out_idx[j] = slot;
+      double mass = s.tree[s.leaf_base + leaf_of(*c, slot)];
+      double q0 = std::max(mass / total, 1e-12);
+      out_weights[j] = std::pow(static_cast<double>(size) * q0 /
+                                    c->n_stripes,
+                                -beta);
+    }
+  }
+  for (int64_t j = 0; j < Bk; ++j) {
+    int64_t slot = out_idx[j];
+    int64_t of = c->obs_seq[slot] % c->frame_capacity;
+    int64_t nf = c->next_seq[slot] % c->frame_capacity;
+    std::memcpy(out_obs + j * c->frame_bytes,
+                c->frames + of * c->frame_bytes, c->frame_bytes);
+    std::memcpy(out_next + j * c->frame_bytes,
+                c->frames + nf * c->frame_bytes, c->frame_bytes);
+    out_action[j] = c->action[slot];
+    out_reward[j] = c->reward[slot];
+    out_discount[j] = c->discount[slot];
+  }
+  return 0;
+}
+
+// Per-stripe half of rc_update: scans the full batch but touches only the
+// slots belonging to `s_i` — each pool worker owns one stripe's tree, so
+// the fan-out has zero cross-stripe lock contention and preserves
+// rc_update's in-order last-write-wins within the stripe.
+void rc_update_stripe(void* h, int32_t s_i, int64_t n, const int64_t* idx,
+                      const float* prio) {
+  Core* c = static_cast<Core*>(h);
+  if (s_i < 0 || s_i >= c->n_stripes) return;
+  int64_t fmin = c->fcount - c->frame_capacity;
+  Stripe& s = c->stripes[s_i];
+  std::lock_guard<std::mutex> g(s.mu);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = idx[i];
+    if (slot < 0 || slot >= c->capacity) continue;
+    if (stripe_of(*c, slot) != s_i) continue;
+    if (!c->alive[slot] || c->obs_seq[slot] < fmin) continue;
+    double p = std::pow(std::max(static_cast<double>(prio[i]), 1e-12),
+                        c->alpha);
     tree_set_one(s, leaf_of(*c, slot), p);
   }
 }

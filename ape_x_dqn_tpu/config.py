@@ -164,6 +164,25 @@ class LearnerConfig:
     # priority staleness, the same order the async Ape-X loop already
     # tolerates.  False is strict sequential PER (the test oracle).
     sample_ahead: bool = False
+    # Overlapped dispatch pipeline (runtime/infeed.DispatchPipeline): max
+    # fused dispatches in flight with no blocking host read between them.
+    # 1 = strict (force each call before the next dispatch — the legacy
+    # fused_inflight policy).  >1 chains dispatches back-to-back: metric
+    # outputs come back via async device→host copies drained one dispatch
+    # behind, so the tunneled platform's ~140 ms post-sync dispatch charge
+    # is paid once per sync instead of once per call, and host-side ingest
+    # staging runs on its own thread while the device scans
+    # (double-buffered ingest).  On the host-replay path, >1 batches the
+    # deferred priority write-back over this many steps instead of one.
+    pipeline_depth: int = 1
+    # Steps between full host syncs of the overlapped pipeline (drain every
+    # in-flight dispatch, blocking).  Bounds how stale the host's view of
+    # loss/metrics can get and is the knob the pipeline-smoke gate asserts
+    # against (host_syncs <= steps/sync_every + slack).  0 = no cadence
+    # sync: the pipeline only blocks when a not-yet-ready dispatch must be
+    # drained for flow control (depth reached) or at emit/exit boundaries.
+    # Fused (device_replay) mode only; ignored at pipeline_depth=1.
+    sync_every: int = 0
 
 
 @dataclasses.dataclass
@@ -328,6 +347,11 @@ class ApexConfig:
              f"unknown optimizer kind: {l.optimizer}"),
             (l.loss in ("huber", "squared"), f"unknown loss kind: {l.loss}"),
             (l.steps_per_call >= 1, "learner.steps_per_call must be >= 1"),
+            (l.pipeline_depth >= 1, "learner.pipeline_depth must be >= 1"),
+            (l.sync_every >= 0, "learner.sync_every must be >= 0"),
+            (not l.sync_every or l.device_replay,
+             "learner.sync_every requires device_replay=True (it paces "
+             "the overlapped fused-dispatch pipeline)"),
             (l.ingest_block >= 1, "learner.ingest_block must be >= 1"),
             (not (l.device_replay and l.data_parallel > 1)
              or l.ingest_block % l.data_parallel == 0,

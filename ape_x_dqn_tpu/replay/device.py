@@ -330,7 +330,13 @@ def build_fused_learn_step(
         (``sync_in_step=True``).
 
       include_ingest: with True (default) each call ingests one chunk
-        before the scan — one dispatch total, the bench/bulk path.  With
+        before the scan — one dispatch total, the bench/bulk path, and the
+        overlapped pipeline's folded-ingest dispatch
+        (``FusedDeviceLearner.train_with_ingest`` builds this variant and
+        rides one full ``ingest_block`` inside each fused call; the add is
+        sequenced before the scan in the same program, so it is bit-for-bit
+        identical to a separate ``device_replay_add`` dispatch — pinned by
+        tests/test_pipeline_overlap.py).  With
         False the signature drops ``chunk``/``chunk_priorities`` and the
         caller ingests at its own cadence via ``device_replay_add`` — the
         async runtime's shape, where actor chunks arrive on their own clock.

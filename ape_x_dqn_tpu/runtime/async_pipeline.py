@@ -117,6 +117,57 @@ class _AsyncPublisher:
                     self._cv.notify_all()
 
 
+class _IngestStagerThread:
+    """Double-buffered ingest: assemble the NEXT dispatch's replay-add
+    blocks while the device scans the current one.
+
+    The fused learners split ingest into host-CPU assembly
+    (``prepare_staged`` — drain the actor-staged chunks, concatenate, carve
+    fixed ``ingest_block`` staging buffers) and the device dispatch
+    (``add_block`` / ``train_with_ingest`` — learner thread only, donation
+    discipline).  This thread runs the assembly half continuously, so the
+    learner thread's per-iteration ingest cost shrinks to the dispatches
+    themselves and host ingest comes off the learner's critical path —
+    tentpole piece (2) of the overlapped pipeline.
+    """
+
+    def __init__(self, fused, stop_event: threading.Event, drain_fn,
+                 period_s: float = 0.005):
+        self._fused = fused
+        self._stop = stop_event
+        self._drain_fn = drain_fn
+        self._period = float(period_s)
+        self.heartbeat = time.monotonic()
+        self.prepared_rows = 0
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="ingest-stager", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._done.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set() and not self._done.is_set():
+            try:
+                n = self._fused.prepare_staged(drain=bool(self._drain_fn()))
+                self.prepared_rows += n
+                self.heartbeat = time.monotonic()
+                if not n:
+                    # Nothing staged: idle briefly instead of spinning a
+                    # core the actors need.
+                    self._done.wait(self._period)
+            except BaseException as e:  # noqa: BLE001 — surfaced by runtime
+                self.error = e
+                return
+
+
 class _ActorWorker:
     """Supervised actor-fleet thread with respawn-on-crash."""
 
@@ -281,6 +332,20 @@ class AsyncPipeline:
         if fused_inflight is None:
             fused_inflight = 8 if self._fused_drain_all else 2
         self._fused_inflight = max(1, int(fused_inflight))
+        # Overlapped dispatch pipeline (learner.pipeline_depth /
+        # learner.sync_every — runtime/infeed.DispatchPipeline): depth > 1
+        # or an explicit sync cadence routes the fused loop through
+        # _run_fused_overlapped, which chains dispatches with zero
+        # intervening host syncs, assembles ingest blocks on a dedicated
+        # stager thread, and drains outputs one dispatch behind.  The
+        # default (1, 0) keeps the legacy force-per-fused_inflight loop.
+        self._pipeline_depth = max(1, int(cfg.learner.pipeline_depth))
+        self._sync_every = max(0, int(cfg.learner.sync_every))
+        self._overlapped = (
+            self._pipeline_depth > 1 or self._sync_every > 0
+        )
+        self._dispatch_pipeline = None
+        self._run_start_step = 0
         self.fused = None
         self.mesh = None
         # SPMD process identity (multi-host; 1/0 when jax.distributed was
@@ -370,6 +435,23 @@ class AsyncPipeline:
 
         ocfg = self.cfg.obs
         self.obs_registry = MetricsRegistry()
+        # Pipeline-overlap instruments (ISSUE 5): host_syncs counts every
+        # BLOCKING device read on the learner thread (a free read of an
+        # already-landed async copy is not a sync — no device idle, no
+        # post-sync dispatch charge); overlap_gap_ms is the observed device
+        # idle window between fused dispatches (0 when new work arrived
+        # while the device was still busy — ingest fully hidden).  Both
+        # live on /varz + /metrics and the JSONL `pipeline` section
+        # (docs/METRICS.md).
+        self._host_syncs = self.obs_registry.counter(
+            "learner/host_syncs",
+            help="blocking device reads on the learner thread",
+        )
+        self._overlap_gap = self.obs_registry.histogram(
+            "learner/overlap_gap_ms",
+            help="device idle between fused dispatches (ms)",
+            min_s=1e-2, max_s=6e4, per_decade=10,
+        )
         self.health = Health(stale_after_s=ocfg.heartbeat_stale_s)
         self._postmortem_dir = self._resolve_postmortem_dir()
         self.recorder = FlightRecorder(
@@ -646,6 +728,27 @@ class AsyncPipeline:
             except Exception:
                 pass
 
+    def _flush_priority_writeback(self, pending: list) -> None:
+        """Commit deferred (indices, priorities) in ONE batched update —
+        step order preserved, so the sum-tree's documented last-write-wins
+        resolves duplicate slots exactly as sequential per-step updates
+        would.  Clears ``pending`` in place."""
+        with self.timers.stage("priority_writeback"):
+            if len(pending) == 1:
+                idx = pending[0][0]
+                prio = self._priorities_host(pending[0][1])
+            else:
+                idx = np.concatenate([i for i, _ in pending])
+                prio = np.concatenate(
+                    [self._priorities_host(p) for _, p in pending]
+                )
+            self.comps.replay.update_priorities(idx, prio)
+        if self._lineage is not None:
+            # The write-back forced the batched steps' device work —
+            # their slots are now TRAINED.
+            self._lineage.on_trained(idx)
+        pending.clear()
+
     def _force_fused(self, metrics) -> None:
         """Force one fused call's completion (tiny host read — see bench.py
         methodology) and credit its steps to the completion-time rate."""
@@ -689,6 +792,8 @@ class AsyncPipeline:
         cfg = self.cfg
         target = learner_steps if learner_steps is not None else cfg.learner.total_steps
         if self.fused is not None:
+            if self._overlapped:
+                return self._run_fused_overlapped(target, warmup_timeout)
             return self._run_fused(target, warmup_timeout)
         self._obs_run_start(target)
         self.worker.start()
@@ -699,7 +804,11 @@ class AsyncPipeline:
                 place_fn=self._place,
                 depth=self._prefetch_depth,
             ) as queue:
-                pending = None  # (indices, device priorities) of previous step
+                # (indices, device priorities) of steps whose write-back is
+                # still deferred — flushed in ONE batched update per
+                # learner.pipeline_depth steps (depth 1 = exact legacy
+                # one-step-behind semantics).
+                pending: list = []
                 metrics = None
                 state = self.comps.state
                 while self._learner_step < target and not self.stop_event.is_set():
@@ -716,20 +825,16 @@ class AsyncPipeline:
                     self.comps.state = state
                     self._learner_step += 1
                     self._steps_rate.add(1)
-                    # Deferred priority write-back: commit the PREVIOUS
-                    # step's priorities now (its device work has finished
-                    # behind the current dispatch), never blocking on the
-                    # step just launched.
-                    if pending is not None:
-                        with self.timers.stage("priority_writeback"):
-                            self.comps.replay.update_priorities(
-                                pending[0], self._priorities_host(pending[1])
-                            )
-                        if self._lineage is not None:
-                            # The write-back forced the previous step's
-                            # device work — its slots are now TRAINED.
-                            self._lineage.on_trained(pending[0])
-                    pending = (host_indices, metrics.priorities)
+                    # Deferred priority write-back, batched per drained
+                    # window: the accumulated steps' device work finished
+                    # behind later dispatches, so the host reads rarely
+                    # block, and one batched update_priorities (+ one
+                    # lineage on_trained) replaces per-step calls — on the
+                    # striped native replay the batch also fans out across
+                    # stripes concurrently.
+                    if len(pending) >= self._pipeline_depth:
+                        self._flush_priority_writeback(pending)
+                    pending.append((host_indices, metrics.priorities))
                     if self._learner_step % cfg.learner.publish_every == 0:
                         with self.timers.stage("publish"):
                             self._publish(state.params)
@@ -742,12 +847,8 @@ class AsyncPipeline:
                     self._maybe_eval()
                     if self._learner_step % self.log_every == 0:
                         self._emit(metrics)
-                if pending is not None:
-                    self.comps.replay.update_priorities(
-                        pending[0], self._priorities_host(pending[1])
-                    )
-                    if self._lineage is not None:
-                        self._lineage.on_trained(pending[0])
+                if pending:
+                    self._flush_priority_writeback(pending)
             self._finish_publishes()
             self._finish_checkpoints()
         except BaseException as e:
@@ -765,6 +866,158 @@ class AsyncPipeline:
         # Final emit carries the last step's metrics (one host sync) so the
         # returned record always has learner/loss — callers assert on it.
         return self._emit(metrics, final=True)
+
+    def _run_fused_overlapped(self, target: int,
+                              warmup_timeout: float) -> dict:
+        """Overlapped dispatch pipeline (learner.pipeline_depth > 1 or an
+        explicit learner.sync_every): chain fused dispatches back-to-back
+        with ZERO intervening host syncs, assemble ingest blocks on the
+        stager thread while the device scans, fold the last full block
+        into the next dispatch (one round trip for add + scan), and drain
+        metric outputs one dispatch behind via async device→host copies.
+
+        Host syncs happen only (a) when flow control must block on a
+        not-yet-ready oldest call (window full), (b) at the sync_every
+        cadence, (c) at emit/checkpoint/exit boundaries — each counted on
+        learner/host_syncs.  The ~140 ms post-sync dispatch charge on
+        tunneled platforms is therefore paid per sync burst, not per call.
+        Bit-for-bit identical to the strict (depth 1) path given the same
+        chunk arrival order — tests/test_pipeline_overlap.py pins it.
+        """
+        import numpy as np
+
+        from ape_x_dqn_tpu.runtime.infeed import DispatchPipeline
+        from ape_x_dqn_tpu.runtime.single_process import beta_schedule
+
+        cfg = self.cfg
+        fused = self.fused
+        self._obs_run_start(target)
+        self._run_start_step = self._learner_step
+        self.worker.start()
+        last_metrics = None
+        pipeline = DispatchPipeline(
+            self._pipeline_depth,
+            probe_fn=lambda m: m.loss,
+            on_retire=lambda _m, steps: self._steps_rate.add(steps),
+            sync_counter=self._host_syncs,
+            gap_hist_ms=self._overlap_gap,
+        )
+        self._dispatch_pipeline = pipeline
+        stager = _IngestStagerThread(
+            fused, self.stop_event, lambda: self.worker.finished
+        )
+        try:
+            self._wait_for_warmup(
+                warmup_timeout,
+                size_fn=lambda: fused.size,
+                tick=lambda: fused.ingest_staged(drain=self.worker.finished),
+            )
+            stager.start()
+            self.health.register(
+                "ingest_stager",
+                lambda: time.monotonic() - stager.heartbeat,
+            )
+            next_log = self._learner_step + self.log_every
+            next_ckpt = (
+                self._learner_step + cfg.learner.checkpoint_every
+                if cfg.learner.checkpoint_every
+                else None
+            )
+            next_sync = (
+                self._learner_step + self._sync_every
+                if self._sync_every else None
+            )
+            while self._learner_step < target \
+                    and not self.stop_event.is_set():
+                self.health.beat("learner")
+                if stager.error is not None:
+                    raise RuntimeError(
+                        "ingest stager failed"
+                    ) from stager.error
+                with self.timers.stage("ingest"):
+                    # Dispatch-only: the blocks were assembled on the
+                    # stager thread.  The last full block rides INSIDE the
+                    # fused call when the learner supports the fold.
+                    blocks = fused.pop_prepared()
+                    fold = None
+                    if blocks and fused.supports_ingest_fold:
+                        prio, _t = blocks[-1]
+                        if len(prio) == cfg.learner.ingest_block:
+                            fold = blocks.pop()
+                    for blk in blocks:
+                        fused.add_block(*blk)
+                beta = beta_schedule(
+                    self._learner_step, cfg.learner.total_steps,
+                    cfg.replay.is_exponent,
+                )
+                with self.timers.stage("fused_dispatch"):
+                    if fold is not None:
+                        last_metrics = pipeline.dispatch(
+                            lambda: fused.train_with_ingest(
+                                beta, fold[0], fold[1]
+                            ),
+                            fused.steps_per_call,
+                        )
+                    else:
+                        last_metrics = pipeline.dispatch(
+                            lambda: fused.train(beta),
+                            fused.steps_per_call,
+                        )
+                self._learner_step += fused.steps_per_call
+                self.comps.state = fused.state
+                if next_sync is not None and self._learner_step >= next_sync:
+                    # Cadence sync: bound how far host-visible metrics and
+                    # flow-control staleness can trail the dispatch edge.
+                    with self.timers.stage("pipeline_sync"):
+                        pipeline.sync()
+                    while next_sync <= self._learner_step:
+                        next_sync += self._sync_every
+                # Publish at most once per fused call (device-side param
+                # copy — not a host sync; the publisher thread does the
+                # slow device_get off this thread).
+                if self._learner_step % max(
+                    cfg.learner.publish_every, fused.steps_per_call
+                ) < fused.steps_per_call:
+                    with self.timers.stage("publish"):
+                        self._publish(fused.params_for_publish())
+                if next_ckpt is not None and self._learner_step >= next_ckpt:
+                    # The snapshot reads the device ring: everything
+                    # dispatched must have landed.
+                    pipeline.sync()
+                    self._save_fused_checkpoint()
+                    next_ckpt += cfg.learner.checkpoint_every
+                self._maybe_eval()
+                if self._learner_step >= next_log:
+                    pipeline.sync()  # emit reads last_metrics host-side
+                    self._emit_fused(last_metrics)
+                    next_log += self.log_every
+            # Flush-at-exit: every dispatched call completes before the
+            # final rates/loss are read (one last sync burst).
+            pipeline.sync()
+            self._finish_publishes()
+            self._finish_checkpoints()
+        except BaseException as e:
+            self._obs_fault(e)
+            raise
+        finally:
+            self.stop_event.set()
+            stager.stop()
+            self.worker.join()
+            if self._publisher is not None:
+                self._publisher.close()
+            self._close_checkpoints()
+            self._close_obs()
+        if stager.error is not None and not isinstance(
+            stager.error, Exception
+        ):
+            raise RuntimeError("ingest stager died") from stager.error
+        if self.worker.error is not None:
+            raise RuntimeError("actor worker died") from self.worker.error
+        if last_metrics is not None:
+            loss = np.asarray(last_metrics.loss)
+            if not np.all(np.isfinite(loss)):
+                raise FloatingPointError("non-finite loss in fused learner")
+        return self._emit_fused(last_metrics, final=True)
 
     def _run_fused(self, target: int, warmup_timeout: float) -> dict:
         """Device-replay mode: ingest staged actor chunks, then fused
@@ -1005,6 +1258,29 @@ class AsyncPipeline:
             return {}
         return {"xp_transport": pool.transport_stats()}
 
+    def _pipeline_extra(self) -> dict:
+        """Overlap accounting on the JSONL stream (docs/METRICS.md
+        ``pipeline`` section): host-sync counts against the steps this
+        session actually ran, plus the device-idle gap distribution —
+        absent unless the overlapped dispatch pipeline is active."""
+        p = self._dispatch_pipeline
+        if p is None:
+            return {}
+        steps = max(1, self._learner_step - self._run_start_step)
+        syncs = self._host_syncs.value
+        gp50 = self._overlap_gap.percentile(50)
+        gp95 = self._overlap_gap.percentile(95)
+        return {"pipeline": {
+            "depth": p.depth,
+            "sync_every": self._sync_every,
+            "host_syncs": int(syncs),
+            "syncs_per_1k_steps": round(1000.0 * syncs / steps, 3),
+            "overlap_gap_ms_p50": round(gp50, 3) if gp50 == gp50 else None,
+            "overlap_gap_ms_p95": round(gp95, 3) if gp95 == gp95 else None,
+            "gaps_observed": p.gaps_observed,
+            "inflight": len(p),
+        }}
+
     def _ckpt_extra(self) -> dict:
         """Incremental-checkpoint accounting on the JSONL stream: saves /
         bases / deltas / bytes, learner-visible stall, and inflight_skips
@@ -1042,6 +1318,7 @@ class AsyncPipeline:
             actor_heartbeat_age=round(time.monotonic() - self.worker.heartbeat, 3),
             stage_us=self.timers.us_per_call(),
             final=final,
+            **self._pipeline_extra(),
             **self._transport_extra(),
             **self._ckpt_extra(),
             **self._obs_extra(),

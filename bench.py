@@ -157,6 +157,48 @@ def _xp_transport_bench(workers=(4, 16, 64), seconds: float = 3.0,
     return out
 
 
+def _pipeline_overlap_bench(steps: int = 6400, steps_per_call: int = 64,
+                            sync_every: int = 1024,
+                            timeout_s: float = 900.0) -> dict:
+    """``pipeline_overlap``: the overlapped dispatch pipeline (ISSUE 5)
+    swept over depth 1 (strict) / 2 / 4 on one fused workload —
+    host-sync counts, steps/s delta, and the device-idle (overlap gap)
+    percentiles.
+
+    Runs tools/pipeline_smoke.py --bench in a CPU-pinned subprocess
+    (host-only by construction: the child forces jax_platforms=cpu, so
+    the section survives TPU-tunnel outages alongside host_replay_2m —
+    and the hard timeout keeps a wedged child from eating the bench
+    line, the outage-proof subprocess probe discipline).  Sync-count and
+    overlap accounting are platform-independent; the ~140 ms/sync charge
+    they amortize is chip-side (PROFILE.md round-6).
+    """
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize TPU-plugin gate
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable, os.path.join(repo, "tools", "pipeline_smoke.py"),
+        "--bench",
+        "--steps", str(steps),
+        "--steps-per-call", str(steps_per_call),
+        "--sync-every", str(sync_every),
+    ]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout_s,
+        env=env, cwd=repo,
+    )
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip()[-400:]
+        raise RuntimeError(f"pipeline_smoke rc={proc.returncode}: {tail}")
+    out = json.loads(proc.stdout.strip().splitlines()[-1])["pipeline_overlap"]
+    out["sync_reduction_10x_at_depth4"] = bool(
+        out.get("sync_reduction_x_depth4", 0) >= 10.0
+    )
+    return out
+
+
 def _make_chunks(rng, n, m, obs_shape, num_actions):
     import jax
     import jax.numpy as jnp
@@ -883,6 +925,12 @@ def main() -> None:
         help="run ONLY the checkpoint_stall section and print its JSON "
         "(artifact generation: demos/ckpt_stall.json)",
     )
+    parser.add_argument("--skip-pipeline-overlap", action="store_true",
+                        help="skip the overlapped-dispatch pipeline sweep "
+                        "(CPU-pinned subprocess; depth 1/2/4)")
+    parser.add_argument("--pipeline-overlap-steps", type=int, default=6400)
+    parser.add_argument("--pipeline-overlap-sync-every", type=int,
+                        default=1024)
     parser.add_argument("--skip-xp-transport", action="store_true",
                         help="skip the shm-ring vs mp.Queue transport bench")
     parser.add_argument("--xp-workers", default="4,16,64",
@@ -983,6 +1031,14 @@ def main() -> None:
                 duration=args.serving_duration,
                 network=args.serving_network,
                 max_batch=args.serving_max_batch)
+    if not args.skip_pipeline_overlap:
+        # Host-only (CPU-pinned subprocess): the overlapped dispatch
+        # pipeline's sync-count / overlap accounting at depth 1/2/4 —
+        # the sync amortization the tunnel's ~140 ms post-sync charge
+        # makes worth measuring even when the chip is unreachable.
+        section("pipeline_overlap", _pipeline_overlap_bench,
+                steps=args.pipeline_overlap_steps,
+                sync_every=args.pipeline_overlap_sync_every)
     if not args.skip_xp_transport:
         # Host-only (no jax in any producer/consumer): the actor→learner
         # transport in isolation, shm ring vs mp.Queue, + SIGKILL barrage.

@@ -33,6 +33,8 @@ def test_bench_emits_host_only_json_during_outage():
         "--xp-seconds", "0.5",
         "--ckpt-capacity", "8192",          # tiny: mechanism, not scale
         "--ckpt-interval-rows", "4096",
+        "--pipeline-overlap-steps", "1024",  # tiny: mechanism, not scale
+        "--pipeline-overlap-sync-every", "256",
     ]
     proc = subprocess.run(
         cmd, capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
@@ -47,8 +49,11 @@ def test_bench_emits_host_only_json_during_outage():
     assert rec["backend_probe"]["error"]
     # Host-only sections survive the outage...
     for key in ("host_replay_2m", "host_dedup_2m", "serving_qps",
-                "xp_transport", "checkpoint_stall"):
+                "xp_transport", "checkpoint_stall", "pipeline_overlap"):
         assert key in rec, f"missing host-only section {key}"
+    po = rec["pipeline_overlap"]
+    assert "error" not in po, po
+    assert po["points"]["depth4"]["inflight_at_exit"] == 0
     assert rec["host_replay_2m"].get("sample_update_pairs_per_sec", 0) > 0
     cs = rec["checkpoint_stall"]
     if "skipped" not in cs:  # native core present on this machine

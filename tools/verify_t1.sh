@@ -14,7 +14,11 @@
 #         /healthz, SIGKILL a worker, assert the salvaged shm stats
 #         block lands as a post-mortem file and lineage spans complete
 #         (tools/obs_smoke.py).
-# Gate 5: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
+# Gate 5: pipeline-overlap smoke — a short OVERLAPPED fused run on CPU
+#         (learner.pipeline_depth=4 + sync_every): asserts host_syncs <=
+#         steps/sync_every + slack and a clean flush-at-exit (zero calls
+#         left in flight, finite loss) — tools/pipeline_smoke.py.
+# Gate 6: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
 #         command changes, change it HERE too (they must stay
 #         character-identical modulo this wrapper's cd).
 cd "$(dirname "$0")/.." || exit 1
@@ -22,4 +26,5 @@ timeout -k 10 120 python -m compileall -q ape_x_dqn_tpu tools || exit 1
 timeout -k 10 180 env JAX_PLATFORMS=cpu python bench.py --xp-transport-smoke > /tmp/_t1_xp.log 2>&1 || { echo "xp_transport smoke FAILED:"; cat /tmp/_t1_xp.log; exit 1; }
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/ckpt_smoke.py > /tmp/_t1_ckpt.log 2>&1 || { echo "checkpoint smoke FAILED:"; cat /tmp/_t1_ckpt.log; exit 1; }
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/obs_smoke.py > /tmp/_t1_obs.log 2>&1 || { echo "obs smoke FAILED:"; cat /tmp/_t1_obs.log; exit 1; }
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/pipeline_smoke.py --steps 2048 > /tmp/_t1_pipe.log 2>&1 || { echo "pipeline smoke FAILED:"; cat /tmp/_t1_pipe.log; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
