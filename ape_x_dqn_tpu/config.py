@@ -96,6 +96,14 @@ class ActorConfig:
     # workers an unthrottled start piles every child's jax import onto the
     # host at once.  0 = spawn back-to-back.
     spawn_stagger_s: float = 0.0
+    # Floor between a worker's death and its respawn, enforced by
+    # ProcessActorPool.supervise() even when no supervisor policy is
+    # attached: a worker whose env crashes deterministically at startup
+    # must not spin the pool through spawn->crash->spawn at process-fork
+    # speed (each cycle is a full jax import plus a ring/stats-block
+    # allocation).  The supervisor's exponential backoff layers ON TOP of
+    # this floor; 0 restores the old immediate-respawn behavior.
+    respawn_min_interval_s: float = 0.25
 
 
 @dataclasses.dataclass
@@ -217,6 +225,14 @@ class ServingConfig:
     max_wait_ms: float = 5.0     # deadline: oldest request's max queue wait
     queue_capacity: int = 256    # admission-control bound (load-shed beyond)
     reload_poll_s: float = 0.25  # param-source poll cadence (hot reload)
+    # Staleness bound on the served params (seconds since the last adopted
+    # snapshot).  Past it the server enters DEGRADED mode: submissions shed
+    # with the typed ServerOverloaded (stale answers are worse than loud
+    # refusals for a policy tier feeding live actors) and the
+    # "serving_params" /healthz component goes 503 until a fresh snapshot
+    # is adopted.  0 disables — a checkpoint-dir source with a legitimately
+    # old final checkpoint should not degrade by default.
+    param_stale_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -260,6 +276,112 @@ class ObsConfig:
 
 
 @dataclasses.dataclass
+class SupervisorConfig:
+    """Fleet supervision policies (runtime/supervisor.py).
+
+    The repo's recovery machinery — SIGKILL-safe rings with salvage, the
+    incremental checkpoint chain, per-component heartbeats — emits signals;
+    this section parameterizes the POLICY layer that consumes them: typed
+    respawn/backoff/quarantine for workers, a learner-progress watchdog
+    with a degrade-before-wedge ladder, and serving staleness shedding
+    (serving.param_stale_s).  Default on: supervision is the contract every
+    scale direction assumes, and with a healthy fleet it costs one idle
+    thread.
+    """
+
+    enabled: bool = True
+    # Worker respawn: exponential backoff (base doubling per death in the
+    # crash-loop window, capped) with multiplicative jitter so a
+    # correlated fleet-wide kill doesn't respawn in lockstep.
+    respawn_backoff_base_s: float = 0.5
+    respawn_backoff_max_s: float = 30.0
+    respawn_jitter: float = 0.25          # +/- fraction of the backoff
+    # Crash-loop budget: more than this many deaths inside the sliding
+    # window quarantines the worker — the fleet shrinks gracefully instead
+    # of hot-looping spawns against a deterministic crash.
+    crash_loop_window_s: float = 120.0
+    crash_loop_budget: int = 5
+    # Learner watchdog: no observable progress (learner step or host-sync
+    # count) for stall_deadline_s degrades the dispatch pipeline to strict
+    # depth 1; still no progress wedge_deadline_s later declares the run
+    # wedged (structured event + /healthz 503) — the operator signal, not
+    # an automatic kill.
+    stall_deadline_s: float = 120.0
+    wedge_deadline_s: float = 120.0
+    poll_s: float = 0.5                   # supervisor thread cadence
+
+    def validate_section(self) -> list:
+        return [
+            (self.respawn_backoff_base_s >= 0.0,
+             "supervisor.respawn_backoff_base_s must be >= 0"),
+            (self.respawn_backoff_max_s >= self.respawn_backoff_base_s,
+             "supervisor.respawn_backoff_max_s must be >= base"),
+            (0.0 <= self.respawn_jitter <= 1.0,
+             "supervisor.respawn_jitter must be in [0, 1]"),
+            (self.crash_loop_window_s > 0.0,
+             "supervisor.crash_loop_window_s must be > 0"),
+            (self.crash_loop_budget >= 1,
+             "supervisor.crash_loop_budget must be >= 1"),
+            (self.stall_deadline_s > 0.0,
+             "supervisor.stall_deadline_s must be > 0"),
+            (self.wedge_deadline_s > 0.0,
+             "supervisor.wedge_deadline_s must be > 0"),
+            (self.poll_s > 0.0, "supervisor.poll_s must be > 0"),
+        ]
+
+
+@dataclasses.dataclass
+class ChaosConfig:
+    """Deterministic fault injection (obs/chaos.py).  Default OFF.
+
+    Every knob is an injection cadence (mean seconds between events of
+    that kind; 0 disables the kind) driven by one seeded schedule, so a
+    chaos run is REPRODUCIBLE: same seed, same fault sequence.  The chaos
+    monkey only ever attacks the run it is attached to — worker processes
+    of its own pool, chunk files of its own checkpoint dir.
+    """
+
+    enabled: bool = False
+    seed: int = 0
+    kill_interval_s: float = 0.0          # SIGKILL a random live worker
+    sigstop_interval_s: float = 0.0       # SIGSTOP + later SIGCONT
+    sigstop_hold_s: float = 0.5
+    # SIGKILL a worker AND scribble an uncommitted torn record into its
+    # ring before salvage — the deterministic "killed mid-write" shape.
+    torn_record_interval_s: float = 0.0
+    # Flip one byte in a committed APXC chunk file (the restore-fallback
+    # path's trigger; takes effect at the next restore, not mid-run).
+    corrupt_chunk_interval_s: float = 0.0
+    # Hold the fused-mode ingest stager idle for stuck_stager_hold_s.
+    stuck_stager_interval_s: float = 0.0
+    stuck_stager_hold_s: float = 1.0
+    # Transient /dev/shm pressure: allocate shm_fill_bytes for hold_s.
+    shm_fill_interval_s: float = 0.0
+    shm_fill_bytes: int = 64 << 20
+    shm_fill_hold_s: float = 1.0
+    # Per-env-step latency injected inside worker processes (mean ms,
+    # seeded jitter) — the slow-env scenario.
+    env_latency_ms: float = 0.0
+
+    def validate_section(self) -> list:
+        nonneg = [
+            ("kill_interval_s", self.kill_interval_s),
+            ("sigstop_interval_s", self.sigstop_interval_s),
+            ("sigstop_hold_s", self.sigstop_hold_s),
+            ("torn_record_interval_s", self.torn_record_interval_s),
+            ("corrupt_chunk_interval_s", self.corrupt_chunk_interval_s),
+            ("stuck_stager_interval_s", self.stuck_stager_interval_s),
+            ("stuck_stager_hold_s", self.stuck_stager_hold_s),
+            ("shm_fill_interval_s", self.shm_fill_interval_s),
+            ("shm_fill_hold_s", self.shm_fill_hold_s),
+            ("env_latency_ms", self.env_latency_ms),
+        ]
+        return [
+            (v >= 0.0, f"chaos.{k} must be >= 0") for k, v in nonneg
+        ] + [(self.shm_fill_bytes >= 0, "chaos.shm_fill_bytes must be >= 0")]
+
+
+@dataclasses.dataclass
 class ApexConfig:
     env: EnvConfig = dataclasses.field(default_factory=EnvConfig)
     actor: ActorConfig = dataclasses.field(default_factory=ActorConfig)
@@ -267,6 +389,10 @@ class ApexConfig:
     replay: ReplayConfig = dataclasses.field(default_factory=ReplayConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    supervisor: SupervisorConfig = dataclasses.field(
+        default_factory=SupervisorConfig
+    )
+    chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     network: str = "conv"                 # "conv" | "nature" | "mlp"
     seed: int = 0
 
@@ -311,6 +437,12 @@ class ApexConfig:
              "must be able to drain at least one chunk per poll)"),
             (a.spawn_stagger_s >= 0.0,
              "actor.spawn_stagger_s must be >= 0"),
+            (a.respawn_min_interval_s >= 0.0,
+             "actor.respawn_min_interval_s must be >= 0"),
+            (s.param_stale_s >= 0.0,
+             "serving.param_stale_s must be >= 0"),
+            *self.supervisor.validate_section(),
+            *self.chaos.validate_section(),
             (a.mode != "process" or a.num_actors >= a.num_workers,
              "actor.num_actors must be >= actor.num_workers in process mode"),
             (l.publish_every >= 1, "learner.publish_every must be >= 1"),
@@ -506,6 +638,7 @@ def _from_native_json(data: dict) -> ApexConfig:
         "env": EnvConfig, "actor": ActorConfig,
         "learner": LearnerConfig, "replay": ReplayConfig,
         "serving": ServingConfig, "obs": ObsConfig,
+        "supervisor": SupervisorConfig, "chaos": ChaosConfig,
     }
     for key, value in data.items():
         if key in sections:

@@ -13,9 +13,19 @@ from ape_x_dqn_tpu.runtime.process_actors import (
     SharedParamBuffer,
 )
 from ape_x_dqn_tpu.runtime.single_process import SingleProcessDriver, beta_schedule
+from ape_x_dqn_tpu.runtime.supervisor import (
+    FleetSupervisor,
+    LearnerWatchdog,
+    RespawnPolicy,
+    ServingStalenessPolicy,
+)
 
 __all__ = [
     "AsyncPipeline",
+    "FleetSupervisor",
+    "LearnerWatchdog",
+    "RespawnPolicy",
+    "ServingStalenessPolicy",
     "Components",
     "FusedDeviceLearner",
     "ParamStore",

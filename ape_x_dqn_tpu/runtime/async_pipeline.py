@@ -132,10 +132,14 @@ class _IngestStagerThread:
     """
 
     def __init__(self, fused, stop_event: threading.Event, drain_fn,
-                 period_s: float = 0.005):
+                 period_s: float = 0.005, stall_fn=None):
         self._fused = fused
         self._stop = stop_event
         self._drain_fn = drain_fn
+        # Chaos gate (obs/chaos.ChaosMonkey.stager_stalled): while it
+        # returns True the stager idles WITHOUT beating its heartbeat —
+        # exactly what a genuinely wedged stager looks like to /healthz.
+        self._stall_fn = stall_fn
         self._period = float(period_s)
         self.heartbeat = time.monotonic()
         self.prepared_rows = 0
@@ -156,6 +160,9 @@ class _IngestStagerThread:
     def _loop(self) -> None:
         while not self._stop.is_set() and not self._done.is_set():
             try:
+                if self._stall_fn is not None and self._stall_fn():
+                    self._done.wait(self._period)
+                    continue
                 n = self._fused.prepare_staged(drain=bool(self._drain_fn()))
                 self.prepared_rows += n
                 self.heartbeat = time.monotonic()
@@ -465,6 +472,23 @@ class AsyncPipeline:
             self._lineage = LineageTracker(
                 self.cfg.replay.capacity, emit=self.logger.event
             )
+        # --- supervision tier (runtime/supervisor) ------------------------
+        # The policy layer over every recovery signal: typed worker
+        # respawn/backoff/quarantine (attached to the process pool below),
+        # the learner-progress watchdog (attached after the run mode is
+        # known), serving staleness (serve.py attaches), and the
+        # fallback-restore counter (degraded restores recorded before this
+        # point — build_components' replay leg — are drained here).
+        self.supervisor = None
+        if self.cfg.supervisor.enabled:
+            from ape_x_dqn_tpu.runtime.supervisor import FleetSupervisor
+
+            self.supervisor = FleetSupervisor(
+                self.cfg.supervisor, registry=self.obs_registry,
+                health=self.health, emit=self.logger.event,
+                seed=self.cfg.seed,
+            )
+        self._chaos = None
         if self.cfg.actor.mode == "process":
             # Actors in CPU-only worker processes: params travel as
             # serialized snapshots through shared memory, experience through
@@ -501,6 +525,8 @@ class AsyncPipeline:
             self.obs_registry.register_provider(
                 "xp_transport", pool.transport_stats
             )
+            if self.supervisor is not None:
+                self.supervisor.attach_pool(pool)
         else:
             self.store = ParamStore(self._params_host(self.comps.state.params))
             self.worker = _ActorWorker(
@@ -619,6 +645,42 @@ class AsyncPipeline:
                 "obs_exporter", port=self.obs_port,
                 url=self.obs_server.url,
             )
+        if self.supervisor is not None:
+            # Learner watchdog: progress is (step, host-sync count) — a
+            # learner wedged INSIDE a dispatch advances neither.  The
+            # degrade action drops a live overlapped pipeline to strict
+            # depth 1; a second silent deadline declares the run wedged
+            # (event + /healthz 503 via the supervisor component).
+            self.supervisor.attach_learner(
+                progress_fn=lambda: (
+                    self._learner_step, int(self._host_syncs.value)
+                ),
+                degrade_fn=self._degrade_pipeline,
+            )
+        if self.cfg.chaos.enabled:
+            # Chaos monkey (obs/chaos): a seeded fault schedule against
+            # THIS run's own workers and checkpoint chain.  Built last so
+            # its counters and provider ride the same registry scrape.
+            from ape_x_dqn_tpu.obs.chaos import ChaosMonkey
+
+            self._chaos = ChaosMonkey(
+                self.cfg.chaos, registry=self.obs_registry,
+                emit=self.logger.event,
+            )
+            pool = getattr(self.worker, "pool", None)
+            ckpt_dirs = (
+                [self.cfg.learner.checkpoint_dir]
+                if self.cfg.learner.checkpoint_every else []
+            )
+            self._chaos.attach(pool=pool, ckpt_dirs=ckpt_dirs)
+
+    def _degrade_pipeline(self) -> None:
+        """Watchdog degrade action: strict dispatch from now on (and a
+        flight-recorder mark — the post-mortem should show the ladder)."""
+        self.recorder.record("pipeline_degraded", step=self._learner_step)
+        p = self._dispatch_pipeline
+        if p is not None:
+            p.degrade()
 
     def _resolve_postmortem_dir(self) -> Optional[str]:
         """obs.postmortem_dir policy: explicit path wins; "auto" lands
@@ -904,7 +966,9 @@ class AsyncPipeline:
         )
         self._dispatch_pipeline = pipeline
         stager = _IngestStagerThread(
-            fused, self.stop_event, lambda: self.worker.finished
+            fused, self.stop_event, lambda: self.worker.finished,
+            stall_fn=(self._chaos.stager_stalled
+                      if self._chaos is not None else None),
         )
         try:
             self._wait_for_warmup(
@@ -1219,6 +1283,10 @@ class AsyncPipeline:
             actor_mode=self.cfg.actor.mode,
         )
         self.health.beat("learner")
+        if self.supervisor is not None:
+            self.supervisor.start()
+        if self._chaos is not None:
+            self._chaos.start()
 
     def _obs_fault(self, e: BaseException) -> None:
         """Fault path: one recorded event + a post-mortem dump.  Both are
@@ -1228,6 +1296,16 @@ class AsyncPipeline:
         self.recorder.dump(self._postmortem_dir, "fault")
 
     def _close_obs(self) -> None:
+        if self._chaos is not None:
+            try:
+                self._chaos.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        if self.supervisor is not None:
+            try:
+                self.supervisor.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         if self.obs_server is not None:
             try:
                 self.obs_server.close()
@@ -1290,6 +1368,23 @@ class AsyncPipeline:
             return {}
         return {"ckpt": self._ckpt_inc.stats()}
 
+    def _supervisor_extra(self) -> dict:
+        """Supervision accounting on the JSONL stream (docs/METRICS.md
+        ``supervisor`` section): the four policy counters plus the live
+        policy state (per-worker backoff, quarantine list, watchdog
+        phase) — absent only when supervisor.enabled=false."""
+        if self.supervisor is None:
+            return {}
+        s = self.supervisor
+        return {"supervisor": {
+            "respawns": int(s.respawns.value),
+            "quarantines": int(s.quarantines.value),
+            "degradations": int(s.degradations.value),
+            "fallback_restores": int(s.fallback_restores.value),
+            "quarantined": sorted(s.respawn_policy.quarantined),
+            "watchdog": s.watchdog.phase if s.watchdog is not None else None,
+        }}
+
     def _emit_fused(self, metrics, final: bool = False) -> dict:
         import numpy as np
 
@@ -1321,6 +1416,7 @@ class AsyncPipeline:
             **self._pipeline_extra(),
             **self._transport_extra(),
             **self._ckpt_extra(),
+            **self._supervisor_extra(),
             **self._obs_extra(),
         )
 
@@ -1391,5 +1487,6 @@ class AsyncPipeline:
             final=final,
             **self._transport_extra(),
             **self._ckpt_extra(),
+            **self._supervisor_extra(),
             **self._obs_extra(),
         )

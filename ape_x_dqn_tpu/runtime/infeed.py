@@ -268,6 +268,15 @@ class DispatchPipeline:
             self._retire(entry)
         return metrics
 
+    def degrade(self) -> None:
+        """Supervisor action (runtime/supervisor.LearnerWatchdog): drop to
+        strict depth 1.  Every subsequent dispatch forces synchronously, so
+        a stall can no longer hide inside a deep in-flight window — the
+        degraded-but-observable mode the watchdog buys time with before
+        declaring the run wedged.  An int store, safe from any thread; the
+        learner thread sees it at its next flow-control check."""
+        self.depth = 1
+
     def drain_ready(self) -> int:
         """Retire every in-flight call whose probe already landed — never
         blocks, never counts as a host sync."""

@@ -234,8 +234,8 @@ def worker_slice(worker_id: int, num_actors: int, num_workers: int) -> tuple:
 
 def _cfg_from_dict(cfg_dict: dict):
     from ape_x_dqn_tpu.config import (
-        ActorConfig, ApexConfig, EnvConfig, LearnerConfig, ObsConfig,
-        ReplayConfig,
+        ActorConfig, ApexConfig, ChaosConfig, EnvConfig, LearnerConfig,
+        ObsConfig, ReplayConfig,
     )
 
     return ApexConfig(
@@ -244,6 +244,7 @@ def _cfg_from_dict(cfg_dict: dict):
         learner=LearnerConfig(**cfg_dict["learner"]),
         replay=ReplayConfig(**cfg_dict["replay"]),
         obs=ObsConfig(**cfg_dict.get("obs", {})),
+        chaos=ChaosConfig(**cfg_dict.get("chaos", {})),
         network=cfg_dict["network"],
         seed=cfg_dict["seed"],
     )
@@ -342,6 +343,18 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
             ))
             for i in range(lo, hi)
         ]
+        if cfg.chaos.enabled and cfg.chaos.env_latency_ms > 0:
+            # Slow-env chaos (obs/chaos.SlowEnv): seeded per actor so the
+            # injected latency stream reproduces with the run.
+            from ape_x_dqn_tpu.obs.chaos import SlowEnv
+
+            lat_s = cfg.chaos.env_latency_ms / 1e3
+            env_fns = [
+                (lambda fn=fn, i=i: SlowEnv(
+                    fn(), lat_s, seed=cfg.chaos.seed + 71 * i
+                ))
+                for i, fn in enumerate(env_fns)
+            ]
         fleet = ActorFleet(
             env_fns,
             network,
@@ -582,6 +595,17 @@ class ProcessActorPool:
         self._dead_since: dict = {}           # wid -> first-seen-dead time
         self._salvaged: list = []             # chunks drained pre-respawn
         self._silent_death_grace_s = 10.0
+        # Supervision seams (runtime/supervisor.FleetSupervisor).  With a
+        # policy attached, respawn timing/budget decisions are ITS —
+        # exponential backoff + crash-loop quarantine replace the blunt
+        # max_restarts fatal; without one, legacy max_restarts semantics
+        # hold.  Either way the respawn_min_interval_s floor stands: a
+        # deterministic startup crash must not spin the pool at fork speed.
+        self.respawn_policy = None
+        self.quarantined: set = set()         # written-off workers
+        self._death_pending: dict = {}        # wid -> error, awaiting respawn
+        self._last_spawn: dict = {}           # wid -> spawn time
+        self._min_respawn_interval = float(cfg.actor.respawn_min_interval_s)
         # Observability: one shm stats block per worker incarnation (slots
         # + flight-recorder event ring, readable after SIGKILL —
         # obs/shm_stats); poll() sweeps them into a cached per-worker
@@ -600,6 +624,7 @@ class ProcessActorPool:
     def _spawn(self, wid: int, budget: int):
         attempt = self._attempt.get(wid, 0)
         self._attempt[wid] = attempt + 1
+        self._last_spawn[wid] = time.monotonic()
         if wid in self._queues:
             self._salvage_incarnation(wid)
         self._queues[wid] = self._ctx.Queue(maxsize=self._queue_size)
@@ -788,49 +813,95 @@ class ProcessActorPool:
         stateless modulo ε/seed, so recovery is respawn + param re-pull —
         the process-mode twin of _ActorWorker._supervise).  A worker that
         exited without a clean "done" — a reported exception OR a silent
-        death (crash, OOM-kill) — restarts with its REMAINING step budget;
-        after ``max_restarts`` total restarts, the next death is fatal
-        (recorded in worker_errors, which stops the pipeline)."""
+        death (crash, OOM-kill) — restarts with its REMAINING step budget.
+
+        Respawn TIMING and BUDGET are policy: with a supervisor attached
+        (``respawn_policy`` — runtime/supervisor.FleetSupervisor), each
+        death is reported once and respawns wait out the policy's
+        exponential backoff; a crash-looping worker is QUARANTINED (ring
+        salvaged, fleet shrinks, run continues).  Without one, legacy
+        semantics: immediate respawns until ``max_restarts``, then the
+        next death is fatal (worker_errors stops the pipeline).  Both
+        paths honor the ``actor.respawn_min_interval_s`` floor — a
+        deterministic startup crash can never spin the pool."""
         if self.stop_event.is_set():
             return
+        now = time.monotonic()
         for wid, p in enumerate(self._procs):
-            if p.is_alive() or wid in self.finished_workers \
-                    or wid in self.worker_errors:
+            if wid in self.finished_workers or wid in self.worker_errors \
+                    or wid in self.quarantined:
                 continue
-            # A zero-exit death is normally a clean "done" (or a reported
-            # error) whose message is still queued — poll() will classify
-            # it.  Only a grace-period timeout turns an unexplained
-            # zero-exit into a silent death (e.g. the final queue put
-            # itself failed), so a clean finisher is never spuriously
-            # respawned nor recorded as a fatal error.
-            if p.exitcode == 0 and wid not in self._reported_errors:
-                first = self._dead_since.setdefault(wid, time.monotonic())
-                if time.monotonic() - first < self._silent_death_grace_s:
+            if wid not in self._death_pending:
+                if p.is_alive():
                     continue
-            self._dead_since.pop(wid, None)
-            err = self._reported_errors.pop(
-                wid, f"worker exited silently (exitcode {p.exitcode})"
-            )
+                # A zero-exit death is normally a clean "done" (or a
+                # reported error) whose message is still queued — poll()
+                # will classify it.  Only a grace-period timeout turns an
+                # unexplained zero-exit into a silent death (e.g. the final
+                # queue put itself failed), so a clean finisher is never
+                # spuriously respawned nor recorded as a fatal error.
+                if p.exitcode == 0 and wid not in self._reported_errors:
+                    first = self._dead_since.setdefault(wid, now)
+                    if now - first < self._silent_death_grace_s:
+                        continue
+                self._dead_since.pop(wid, None)
+                err = self._reported_errors.pop(
+                    wid, f"worker exited silently (exitcode {p.exitcode})"
+                )
+                budget = max(
+                    0, self.cfg.actor.T - self._steps_by_worker.get(wid, 0)
+                )
+                if budget == 0:
+                    # Budget exhausted = a clean finish whatever the exit
+                    # shape — no respawn, no restart credit consumed.
+                    self.finished_workers.add(wid)
+                    continue
+                if self.respawn_policy is not None:
+                    if self.respawn_policy.on_worker_death(wid, err) \
+                            == "quarantine":
+                        self._quarantine(wid)
+                        continue
+                elif self.restarts >= self.max_restarts:
+                    self.worker_errors[wid] = err
+                    continue
+                self._death_pending[wid] = err
+            # Death recorded; respawn when the interval floor AND the
+            # policy's backoff (if any) have both elapsed.
+            if now - self._last_spawn.get(wid, 0.0) \
+                    < self._min_respawn_interval:
+                continue
+            if self.respawn_policy is not None:
+                verdict = self.respawn_policy.decide_respawn(wid)
+                if verdict == "wait":
+                    continue
+                if verdict == "quarantine":
+                    self._quarantine(wid)
+                    continue
+            self._death_pending.pop(wid, None)
             budget = max(
                 0, self.cfg.actor.T - self._steps_by_worker.get(wid, 0)
             )
-            if budget == 0:
-                # Budget exhausted = a clean finish whatever the exit shape
-                # — no respawn needed, so no restart credit is consumed.
-                self.finished_workers.add(wid)
-                continue
-            if self.restarts >= self.max_restarts:
-                self.worker_errors[wid] = err
-                continue
             self.restarts += 1
             self._procs[wid] = self._spawn(wid, budget)
+
+    def _quarantine(self, wid: int) -> None:
+        """Write a crash-looping worker off: salvage its last incarnation
+        (committed records delivered, torn tail counted, post-mortem
+        written) and shrink the fleet — the run continues without it."""
+        self._death_pending.pop(wid, None)
+        self.quarantined.add(wid)
+        if wid in self._queues:
+            self._salvage_incarnation(wid)
 
     def publish(self, params) -> int:
         return self.store.publish(params)
 
     @property
     def finished(self) -> bool:
-        return len(self.finished_workers) + len(self.worker_errors) >= self.num_workers
+        return (
+            len(self.finished_workers) + len(self.worker_errors)
+            + len(self.quarantined)
+        ) >= self.num_workers
 
     def poll(self, max_items: int = 64, timeout: float = 0.0,
              max_bytes: Optional[int] = None,
@@ -964,10 +1035,16 @@ class ProcessActorPool:
                 p.join(timeout=5.0)
         self.poll(max_items=256)  # last committed records + "done" messages
         # Release every shm segment and control-queue fd on ALL exit paths
-        # (the 256-worker fd/shm budget depends on it).
+        # (the 256-worker fd/shm budget depends on it).  Rings retired here
+        # still settle their salvage accounting: a worker killed just
+        # before stop leaves a torn tail nobody respawned past — it must
+        # land on the transport's torn counter, not vanish with the unlink
+        # (the chaos soak's every-tear-detected invariant).
         for wid in list(self._rings):
             ring = self._rings.pop(wid)
             self._full_waits_base += ring.full_waits
+            if ring.torn_tail():
+                self.transport.count_salvage(0, torn=True)
             ring.close()
             ring.unlink()
         for wid in list(self._queues):

@@ -131,6 +131,27 @@ def main(argv=None) -> int:
     server.warmup(comps.obs_shape)
     server.start()
 
+    # Serving staleness policy (runtime/supervisor): past
+    # serving.param_stale_s of source silence the server sheds with the
+    # typed ServerOverloaded and /healthz goes 503 — stale answers from a
+    # dead source are a failure mode, not a feature.  Under --attach the
+    # trainer's FleetSupervisor ticks it; standalone the metrics loop does.
+    staleness = None
+    if cfg.serving.param_stale_s > 0:
+        if pipe is not None and pipe.supervisor is not None:
+            staleness = pipe.supervisor.attach_serving(
+                server, cfg.serving.param_stale_s
+            )
+        else:
+            from ape_x_dqn_tpu.runtime.supervisor import (
+                ServingStalenessPolicy,
+            )
+
+            staleness = ServingStalenessPolicy(
+                server, cfg.serving.param_stale_s,
+                on_event=lambda kind, **f: logger.event(kind, **f),
+            )
+
     # Observability exporter over the serving tier (and, under --attach,
     # the trainer's registry too — one scrape covers both halves).
     obs_server = None
@@ -150,6 +171,11 @@ def main(argv=None) -> int:
             "serving_batcher",
             lambda: time.monotonic() - server._batcher.heartbeat,
         )
+        if staleness is not None:
+            health.register(
+                "serving_params", staleness.age_s,
+                stale_after_s=cfg.serving.param_stale_s,
+            )
         obs_server = ObsServer(registry, health, port=obs_port)
         logger.event("obs_exporter", port=obs_server.port,
                      url=obs_server.url)
@@ -173,6 +199,8 @@ def main(argv=None) -> int:
         deadline = time.monotonic() + args.duration
         while time.monotonic() < deadline:
             time.sleep(min(args.metrics_every, max(0.0, deadline - time.monotonic())))
+            if staleness is not None:
+                staleness.check()
             server.emit_metrics(logger)
             if trainer_thread is not None and not trainer_thread.is_alive():
                 break

@@ -24,7 +24,11 @@ from typing import Any, Optional
 import numpy as np
 
 from ape_x_dqn_tpu.models.dueling import build_greedy_apply
-from ape_x_dqn_tpu.serving.batcher import MicroBatcher, ServedAction
+from ape_x_dqn_tpu.serving.batcher import (
+    MicroBatcher,
+    ServedAction,
+    ServerOverloaded,
+)
 
 
 class PolicyServer:
@@ -69,6 +73,12 @@ class PolicyServer:
         # by ONE local bind per batch (_run_batch) — atomic either side.
         self._live = (jax.device_put(params), int(version), time.monotonic())
         self.reload_count = 0
+        # Degraded mode (runtime/supervisor.ServingStalenessPolicy): when
+        # the param source goes stale past the operator's bound, new
+        # submissions shed with the typed ServerOverloaded — for a policy
+        # tier feeding live traffic, a loud refusal beats a silently
+        # ancient answer.  A bool store, toggled by the policy's check.
+        self.degraded = False
         self._stop = threading.Event()
         self._batcher = MicroBatcher(
             self._run_batch,
@@ -130,7 +140,17 @@ class PolicyServer:
     # -- request path -----------------------------------------------------
 
     def submit(self, obs):
-        """Non-blocking: Future of ServedAction (typed errors on overload)."""
+        """Non-blocking: Future of ServedAction.  Typed errors on overload
+        — including the degraded stale-params mode, which sheds here (and
+        counts with the batcher's load-shed) rather than serving answers
+        from a param source known to be dead."""
+        if self.degraded:
+            self._batcher.shed_count += 1
+            raise ServerOverloaded(
+                f"serving degraded: params stale "
+                f"{self.param_age_s:.1f}s (source quiet past the "
+                "configured bound); retry later"
+            )
         return self._batcher.submit(obs)
 
     def act(self, obs, timeout: Optional[float] = 10.0) -> ServedAction:
@@ -173,6 +193,12 @@ class PolicyServer:
     def param_version(self) -> int:
         return self._live[1]
 
+    @property
+    def param_age_s(self) -> float:
+        """Seconds since the live params were adopted — the staleness
+        signal the supervisor's serving policy compares to its bound."""
+        return time.monotonic() - self._live[2]
+
     def stats(self) -> dict:
         """Serving metrics snapshot (the JSONL emit loop's source)."""
         b = self._batcher
@@ -185,6 +211,7 @@ class PolicyServer:
             "queue_depth": b.queue_depth,
             "param_version": version,
             "param_age_s": round(time.monotonic() - swapped_at, 3),
+            "degraded": self.degraded,
             "reloads": self.reload_count,
             "batch_hist": {str(k): v for k, v in sorted(b.batch_hist.items())},
             "latency": b.latency.summary(),

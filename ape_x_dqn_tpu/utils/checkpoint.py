@@ -192,7 +192,9 @@ def load_replay_snapshot(root_or_path: str, replay,
 
 
 def load_replay_leg(root_or_path: str, replay,
-                    replay_suffix: str = "") -> Optional[str]:
+                    replay_suffix: str = "",
+                    fallback: bool = True,
+                    on_fallback=None) -> Optional[str]:
     """Restore the replay from whichever leg the checkpoint has: the
     step dir's ``replay<suffix>.npz`` snapshot first, else the committed
     incremental chain under ``<root>/replay_inc<suffix>/``
@@ -200,9 +202,13 @@ def load_replay_leg(root_or_path: str, replay,
     writes no per-step npz at all).  Returns ``"snapshot"``,
     ``"incremental"``, or None when the checkpoint has no replay leg.
 
-    A chain the manifest references but whose chunk fails its CRC raises
-    ``checkpoint_inc.ChunkCorrupt`` — real corruption is never silently
-    degraded to an empty buffer.
+    This is the PRODUCTION restore path, so ``fallback`` defaults to the
+    supervised policy: a corrupt chunk walks the chain back to the longest
+    good prefix or the previous committed generation, with a structured
+    ``degraded_restore`` event (checkpoint_inc.load_incremental_replay)
+    instead of crashing the resume.  Only a chain with no restorable rung
+    raises ``checkpoint_inc.ChunkCorrupt`` — real unrecoverable corruption
+    is never silently degraded to an empty buffer.
     """
     try:
         if load_replay_snapshot(root_or_path, replay,
@@ -217,7 +223,9 @@ def load_replay_leg(root_or_path: str, replay,
     root = os.path.abspath(root_or_path)
     if _STEP_RE.match(os.path.basename(root)):
         root = os.path.dirname(root)
-    if load_incremental_replay(root, replay, suffix=replay_suffix) is not None:
+    if load_incremental_replay(root, replay, suffix=replay_suffix,
+                               fallback=fallback,
+                               on_event=on_fallback) is not None:
         return "incremental"
     return None
 

@@ -79,7 +79,27 @@ _MANIFEST = "MANIFEST.json"
 
 
 class ChunkCorrupt(ValueError):
-    """A chunk file failed its CRC / framing check (torn or bit-rotted)."""
+    """A chunk file failed its CRC / framing / decode check (torn,
+    truncated, or bit-rotted).
+
+    Typed so callers can ACT on it — the restore fallback walks back a
+    generation, the supervisor counts it — instead of pattern-matching a
+    raw ``struct.error``/``zlib.error`` message.  Carries the chunk
+    ``path`` and, when the filename encodes one, the ``generation`` and
+    chain ``index`` of the bad chunk.
+    """
+
+    def __init__(self, message: str, path: Optional[str] = None,
+                 generation: Optional[int] = None,
+                 index: Optional[int] = None):
+        super().__init__(message)
+        self.path = path
+        if path is not None and (generation is None or index is None):
+            g, k = _parse_chunk_name(os.path.basename(path))
+            generation = generation if generation is not None else g
+            index = index if index is not None else k
+        self.generation = generation
+        self.index = index
 
 
 def inc_dir(root: str, suffix: str = "") -> str:
@@ -88,6 +108,18 @@ def inc_dir(root: str, suffix: str = "") -> str:
 
 def _chunk_name(gen: int, idx: int) -> str:
     return f"chunk_{gen}_{idx}.ckpt"
+
+
+def _parse_chunk_name(name: str):
+    """(generation, index) from a ``chunk_<G>_<k>.ckpt`` basename, or
+    (None, None) for anything else."""
+    parts = name.split("_")
+    if len(parts) == 3 and parts[0] == "chunk" and parts[2].endswith(".ckpt"):
+        try:
+            return int(parts[1]), int(parts[2][:-len(".ckpt")])
+        except ValueError:
+            pass
+    return None, None
 
 
 def _fsync_dir(path: str) -> None:
@@ -124,28 +156,46 @@ def write_chunk(path: str, arrays: dict, compress: bool = False) -> int:
 
 
 def read_chunk(path: str) -> dict:
-    """Decode one chunk file back to its array dict; ``ChunkCorrupt`` on a
-    truncated header/payload or a CRC mismatch."""
+    """Decode one chunk file back to its array dict; ``ChunkCorrupt`` (with
+    the path + parsed generation attached) on a zero-length or header-only
+    file, a truncated payload, a CRC mismatch, or any decode failure past
+    the CRC — a corrupted chunk must surface as ONE typed error, never a
+    raw struct/zlib/json traceback the caller cannot classify."""
     with open(path, "rb") as f:
         data = f.read()
     if len(data) < _CHUNK_HDR.size:
-        raise ChunkCorrupt(f"{path}: truncated header "
-                           f"({len(data)} < {_CHUNK_HDR.size} bytes)")
+        raise ChunkCorrupt(
+            f"{path}: truncated header ({len(data)} < {_CHUNK_HDR.size} "
+            "bytes)", path=path,
+        )
     magic, version, flags, plen, crc = _CHUNK_HDR.unpack_from(data, 0)
     if magic != _CHUNK_MAGIC:
-        raise ChunkCorrupt(f"{path}: bad magic {magic!r}")
+        raise ChunkCorrupt(f"{path}: bad magic {magic!r}", path=path)
     if version != _CHUNK_VERSION:
-        raise ChunkCorrupt(f"{path}: unsupported chunk version {version}")
+        raise ChunkCorrupt(
+            f"{path}: unsupported chunk version {version}", path=path
+        )
     payload = data[_CHUNK_HDR.size:]
     if len(payload) != plen:
         raise ChunkCorrupt(
-            f"{path}: truncated payload ({len(payload)} != {plen} bytes)"
+            f"{path}: truncated payload ({len(payload)} != {plen} bytes)",
+            path=path,
         )
     if zlib.crc32(payload) != crc:
-        raise ChunkCorrupt(f"{path}: crc mismatch (torn or corrupted chunk)")
-    if flags & _FLAG_ZLIB:
-        payload = zlib.decompress(payload)
-    return unpack_arrays(payload, copy=True)
+        raise ChunkCorrupt(
+            f"{path}: crc mismatch (torn or corrupted chunk)", path=path
+        )
+    try:
+        if flags & _FLAG_ZLIB:
+            payload = zlib.decompress(payload)
+        return unpack_arrays(payload, copy=True)
+    except ChunkCorrupt:
+        raise
+    except Exception as e:  # noqa: BLE001 — decode failure IS corruption
+        raise ChunkCorrupt(
+            f"{path}: undecodable payload past CRC "
+            f"({type(e).__name__}: {e})", path=path,
+        ) from e
 
 
 def read_manifest(directory: str) -> Optional[dict]:
@@ -156,19 +206,101 @@ def read_manifest(directory: str) -> Optional[dict]:
         return json.load(f)
 
 
+def _archived_manifest_name(gen: int) -> str:
+    return f"MANIFEST.gen{gen}.json"
+
+
+def read_archived_manifest(directory: str, gen: int) -> Optional[dict]:
+    """The per-generation manifest archive (written alongside every commit)
+    — what the restore fallback walks when the live generation is bad."""
+    path = os.path.join(directory, _archived_manifest_name(gen))
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (ValueError, OSError):
+        return None  # a torn archive is just a missing fallback rung
+
+
 def _write_manifest(directory: str, manifest: dict) -> None:
-    """fsync + os.replace: the atomic commit marker, written LAST."""
+    """fsync + os.replace: the atomic commit marker, written LAST.  The
+    same record is also archived per generation (``MANIFEST.gen<G>.json``)
+    so a later generation's corruption can walk back to this one."""
     path = os.path.join(directory, _MANIFEST)
-    tmp = f"{path}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(manifest, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    for target in (
+        os.path.join(directory,
+                     _archived_manifest_name(int(manifest["generation"]))),
+        path,
+    ):
+        tmp = f"{target}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, target)
     _fsync_dir(directory)
 
 
-def load_incremental_replay(root: str, replay, suffix: str = "") -> Optional[int]:
+# Fallback restores recorded by load_incremental_replay (module-level so
+# restores that happen before the supervisor exists — build_components —
+# still reach its fallback_restores counter; the supervisor drains this
+# at construction).
+FALLBACK_EVENTS: list = []
+
+
+def consume_fallback_events() -> list:
+    """Drain-and-return the recorded degraded-restore events."""
+    out, FALLBACK_EVENTS[:] = list(FALLBACK_EVENTS), []
+    return out
+
+
+def _note_fallback(on_event, **fields) -> dict:
+    event = {"event": "degraded_restore", **fields}
+    FALLBACK_EVENTS.append(event)
+    try:
+        from ape_x_dqn_tpu.utils.metrics import emit_event
+
+        emit_event("degraded_restore", **fields)
+    except Exception:  # noqa: BLE001 — restore must not die on telemetry
+        pass
+    if on_event is not None:
+        try:
+            on_event(event)
+        except Exception:  # noqa: BLE001
+            pass
+    return event
+
+
+def _apply_chain(directory: str, replay, chunks: list) -> None:
+    """Base + deltas in chain order; every failure is a typed
+    ``ChunkCorrupt`` carrying the offending path (a manifest-referenced
+    file that has gone missing counts — the chain is broken either way)."""
+    head = os.path.join(directory, chunks[0])
+    try:
+        base = read_chunk(head)
+    except FileNotFoundError as e:
+        raise ChunkCorrupt(f"{head}: referenced chunk missing",
+                           path=head) from e
+    if "delta" in base:
+        raise ChunkCorrupt(
+            f"{chunks[0]}: generation head is a delta, not a base",
+            path=head,
+        )
+    replay.load_state_dict(base)
+    for name in chunks[1:]:
+        path = os.path.join(directory, name)
+        try:
+            delta = read_chunk(path)
+        except FileNotFoundError as e:
+            raise ChunkCorrupt(f"{path}: referenced chunk missing",
+                               path=path) from e
+        replay.apply_delta_state_dict(delta)
+
+
+def load_incremental_replay(root: str, replay, suffix: str = "",
+                            fallback: bool = False,
+                            on_event=None) -> Optional[int]:
     """Restore ``replay`` from the newest committed manifest under
     ``<root>/replay_inc<suffix>/``: base first, then every delta in chain
     order.  Returns the manifest's training step, or None when no committed
@@ -176,6 +308,16 @@ def load_incremental_replay(root: str, replay, suffix: str = "") -> Optional[int
     raises ``ChunkCorrupt`` (real corruption — never silently skipped);
     files beyond the manifest (an uncommitted tail from a killed writer)
     are ignored.
+
+    ``fallback=True`` is the SUPERVISED restore: on a corrupt chunk it
+    walks back — first to the live generation's longest good prefix (exact
+    recovery to that delta's committed step, via the manifest's per-chunk
+    ``chunk_steps``), then to prior generations' archived manifests — and
+    records a structured ``degraded_restore`` event (JSONL +
+    ``FALLBACK_EVENTS`` for the supervisor's counter) instead of crashing
+    the resume.  Only when no committed rung restores does the original
+    ``ChunkCorrupt`` surface.  Restores are never silently wrong: every
+    accepted rung replayed through the same CRC-checked chain apply.
     """
     directory = inc_dir(root, suffix)
     manifest = read_manifest(directory)
@@ -184,17 +326,63 @@ def load_incremental_replay(root: str, replay, suffix: str = "") -> Optional[int
     chunks = manifest["chunks"]
     if not chunks:
         return None
-    base = read_chunk(os.path.join(directory, chunks[0]))
-    if "delta" in base:
-        raise ChunkCorrupt(
-            f"{chunks[0]}: generation head is a delta, not a base"
-        )
-    replay.load_state_dict(base)
-    for name in chunks[1:]:
-        replay.apply_delta_state_dict(
-            read_chunk(os.path.join(directory, name))
-        )
-    return int(manifest.get("step", 0))
+    try:
+        _apply_chain(directory, replay, chunks)
+        return int(manifest.get("step", 0))
+    except ChunkCorrupt as err:
+        if not fallback:
+            raise
+        return _fallback_restore(directory, replay, manifest, err, on_event)
+
+
+def _fallback_restore(directory: str, replay, manifest: dict,
+                      err: ChunkCorrupt, on_event) -> int:
+    chunks = list(manifest["chunks"])
+    steps = manifest.get("chunk_steps")
+    # Position of the bad chunk in the live chain (by path, the reliable
+    # key — err.index is the filename's chain slot, identical for intact
+    # names but absent on weird paths).
+    bad_pos = None
+    if err.path is not None:
+        base_name = os.path.basename(err.path)
+        if base_name in chunks:
+            bad_pos = chunks.index(base_name)
+    # Rung 1: the live generation's longest good prefix — only when the
+    # manifest records per-chunk steps (otherwise the restored step would
+    # be a guess, and a wrong step is a wrong-data load by another name).
+    if bad_pos and steps and len(steps) == len(chunks):
+        try:
+            _apply_chain(directory, replay, chunks[:bad_pos])
+            step = int(steps[bad_pos - 1])
+            _note_fallback(
+                on_event, fallback="partial_chain",
+                directory=directory,
+                generation=int(manifest["generation"]),
+                chunks_dropped=len(chunks) - bad_pos,
+                step=step, error=str(err),
+            )
+            return step
+        except ChunkCorrupt as e2:
+            err = e2
+    # Rung 2: walk prior generations' archived manifests (pruning retains
+    # one full prior generation for exactly this).
+    gen = int(manifest["generation"]) - 1
+    while gen >= 0:
+        archived = read_archived_manifest(directory, gen)
+        if archived is None or not archived.get("chunks"):
+            break
+        try:
+            _apply_chain(directory, replay, archived["chunks"])
+            step = int(archived.get("step", 0))
+            _note_fallback(
+                on_event, fallback="previous_generation",
+                directory=directory, generation=gen,
+                step=step, error=str(err),
+            )
+            return step
+        except ChunkCorrupt:
+            gen -= 1
+    raise err
 
 
 class IncrementalCheckpointer:
@@ -211,13 +399,17 @@ class IncrementalCheckpointer:
 
     def __init__(self, root: str, replay, suffix: str = "",
                  base_every: int = 16, compress: bool = False,
-                 sync: bool = False):
+                 sync: bool = False, keep_generations: int = 2):
         self._dir = inc_dir(root, suffix)
         os.makedirs(self._dir, exist_ok=True)
         self._replay = replay
         self._base_every = max(1, int(base_every))
         self._compress = bool(compress)
         self._sync = bool(sync)
+        # Generations retained on disk (current + fallback rungs): the
+        # restore fallback can only walk back to a generation whose files
+        # survived pruning.  2 = current + one committed predecessor.
+        self._keep_generations = max(1, int(keep_generations))
         # Chain continuation: adopt the committed manifest's position.  The
         # first save() chains onto it only if the replay's own counters
         # still match its chain_mark (i.e. the replay was restored from
@@ -367,20 +559,31 @@ class IncrementalCheckpointer:
         if is_base:
             gen = (0 if self._manifest is None
                    else int(self._manifest["generation"]) + 1)
-            idx, chunks = 0, []
+            idx, chunks, chunk_steps = 0, [], []
         else:
             gen = int(self._manifest["generation"])
             chunks = list(self._manifest["chunks"])
             idx = len(chunks)
+            prev_steps = self._manifest.get("chunk_steps")
+            # Per-chunk steps power exact partial-chain fallback; a legacy
+            # manifest without them just loses that rung (never guessed).
+            chunk_steps = (
+                list(prev_steps)
+                if prev_steps is not None and len(prev_steps) == idx
+                else None
+            )
         name = _chunk_name(gen, idx)
         nbytes = write_chunk(os.path.join(self._dir, name), arrays,
                              compress=self._compress)
         chunks.append(name)
+        if chunk_steps is not None:
+            chunk_steps.append(int(step))
         mark = arrays.get("chain_mark")  # absent on degraded (no-delta) replays
         manifest = {
             "version": 1,
             "generation": gen,
             "chunks": chunks,
+            "chunk_steps": chunk_steps,
             "step": int(step),
             "chain_mark": (np.asarray(mark).reshape(-1).tolist()
                            if mark is not None else None),
@@ -399,16 +602,24 @@ class IncrementalCheckpointer:
 
 
     def _prune(self, live_gen: int) -> None:
-        """Once the manifest names generation ``live_gen``, every older
-        generation's files are unreferenced — remove them."""
+        """Once the manifest names generation ``live_gen``, generations
+        older than the retention horizon are removed — chunks AND archived
+        manifests.  The newest ``keep_generations - 1`` predecessors stay
+        on disk as the restore fallback's walk-back rungs."""
+        horizon = live_gen - (self._keep_generations - 1)
         for name in os.listdir(self._dir):
-            if not name.startswith("chunk_"):
-                continue
-            try:
-                gen = int(name.split("_")[1])
-            except (IndexError, ValueError):
-                continue
-            if gen < live_gen:
+            gen = None
+            if name.startswith("chunk_"):
+                try:
+                    gen = int(name.split("_")[1])
+                except (IndexError, ValueError):
+                    continue
+            elif name.startswith("MANIFEST.gen") and name.endswith(".json"):
+                try:
+                    gen = int(name[len("MANIFEST.gen"):-len(".json")])
+                except ValueError:
+                    continue
+            if gen is not None and gen < horizon:
                 try:
                     os.unlink(os.path.join(self._dir, name))
                 except OSError:

@@ -1,0 +1,186 @@
+"""Chaos injection framework (obs/chaos.py): the injectors and the seeded
+schedule.
+
+The contract under test: every injector produces a fault the recovery
+machinery DETECTS (torn tails counted and never delivered; corrupted
+chunks typed as ChunkCorrupt with path + generation), and the monkey's
+schedule is a pure function of (config, seed) — a failing chaos run
+reproduces.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.config import ChaosConfig
+from ape_x_dqn_tpu.obs.chaos import (
+    ChaosMonkey,
+    ShmFiller,
+    SlowEnv,
+    corrupt_chunk,
+    inject_torn_record,
+    pick_chunk,
+)
+
+
+class TestTornRecordInjection:
+    def _ring(self, capacity=1 << 16):
+        from ape_x_dqn_tpu.runtime.shm_ring import ShmRing
+
+        return ShmRing(capacity)
+
+    def test_committed_records_survive_torn_tail_never_delivered(self):
+        ring = self._ring()
+        try:
+            payloads = [bytes([i]) * 100 for i in range(3)]
+            for p in payloads:
+                assert ring.try_write([p])
+            rec = inject_torn_record(ring, rng=random.Random(1))
+            assert rec["fault"] == "torn_record"
+            # Every committed record drains intact; the torn tail is never
+            # delivered, and salvage accounting sees it.
+            assert ring.drain() == payloads
+            assert ring.read_next() is None
+            assert ring.torn_tail()
+        finally:
+            ring.close()
+            ring.unlink()
+
+    def test_writer_can_resume_is_not_required_ring_is_retired(self):
+        # The production discipline retires a torn ring (fresh ring per
+        # incarnation); this only pins that the reader never misreads the
+        # garbage as data even after more scans.
+        ring = self._ring()
+        try:
+            assert ring.try_write([b"x" * 64])
+            inject_torn_record(ring, rng=random.Random(2))
+            assert len(ring.drain()) == 1
+            for _ in range(3):
+                assert ring.read_next() is None
+        finally:
+            ring.close()
+            ring.unlink()
+
+
+class TestCorruptChunk:
+    def _write(self, tmp_path, name="chunk_3_1.ckpt"):
+        from ape_x_dqn_tpu.utils.checkpoint_inc import write_chunk
+
+        path = str(tmp_path / name)
+        write_chunk(path, {"a": np.arange(64, dtype=np.int64),
+                           "b": np.ones((8, 8), np.float32)})
+        return path
+
+    @pytest.mark.parametrize("mode", ["bitflip", "truncate", "zero"])
+    def test_all_modes_surface_as_typed_chunk_corrupt(self, tmp_path, mode):
+        from ape_x_dqn_tpu.utils.checkpoint_inc import ChunkCorrupt, read_chunk
+
+        path = self._write(tmp_path)
+        rec = corrupt_chunk(path, mode, rng=random.Random(5))
+        assert rec["mode"] == mode
+        with pytest.raises(ChunkCorrupt) as ei:
+            read_chunk(path)
+        # The typed error carries the forensic fields (satellite 2).
+        assert ei.value.path == path
+        assert ei.value.generation == 3
+        assert ei.value.index == 1
+
+    def test_unknown_mode_rejected(self, tmp_path):
+        path = self._write(tmp_path)
+        with pytest.raises(ValueError, match="unknown corruption mode"):
+            corrupt_chunk(path, "melt")
+
+    def test_pick_chunk_respects_manifest_and_preference(self, tmp_path):
+        import json
+
+        inc = tmp_path / "replay_inc"
+        inc.mkdir()
+        for name in ("chunk_0_0.ckpt", "chunk_0_1.ckpt"):
+            self._write(inc, name)
+        assert pick_chunk(str(inc)) is None  # no manifest, no pick
+        (inc / "MANIFEST.json").write_text(json.dumps(
+            {"chunks": ["chunk_0_0.ckpt", "chunk_0_1.ckpt"]}
+        ))
+        base = pick_chunk(str(inc), prefer="base")
+        delta = pick_chunk(str(inc), prefer="delta")
+        assert base.endswith("chunk_0_0.ckpt")
+        assert delta.endswith("chunk_0_1.ckpt")
+
+
+class TestSlowEnv:
+    class _Env:
+        observation_shape = (4,)
+        num_actions = 2
+
+        def reset(self):
+            return np.zeros(4, np.uint8)
+
+        def step(self, a):
+            return np.zeros(4, np.uint8), 1.0, False, {}
+
+    def test_latency_injected_semantics_preserved(self):
+        import time
+
+        env = SlowEnv(self._Env(), latency_s=0.01, seed=3)
+        assert env.observation_shape == (4,)  # delegation
+        assert env.num_actions == 2
+        env.reset()
+        t0 = time.monotonic()
+        for _ in range(5):
+            obs, r, done, info = env.step(0)
+        elapsed = time.monotonic() - t0
+        assert r == 1.0 and not done
+        assert elapsed >= 5 * 0.01 * 0.5  # at least the jitter floor
+
+
+class TestShmFiller:
+    def test_fill_and_release(self):
+        f = ShmFiller()
+        rec = f.fill(1 << 20)
+        assert rec["fault"] == "shm_fill"
+        f.release()
+        f.release()  # idempotent
+
+
+class TestSchedule:
+    def _cfg(self, **over):
+        base = dict(enabled=True, seed=13, kill_interval_s=2.0,
+                    torn_record_interval_s=5.0, sigstop_interval_s=0.0)
+        base.update(over)
+        return ChaosConfig(**base)
+
+    def test_same_seed_same_schedule(self):
+        a = ChaosMonkey(self._cfg(), horizon_s=60.0)
+        b = ChaosMonkey(self._cfg(), horizon_s=60.0)
+        assert a.schedule == b.schedule
+        assert a.schedule, "enabled kinds must schedule events"
+        kinds = {k for _, k in a.schedule}
+        assert kinds == {"kill", "torn_record"}
+        # Sorted timeline, events respect the mean-interval envelope.
+        times = [t for t, _ in a.schedule]
+        assert times == sorted(times)
+
+    def test_different_seed_different_schedule(self):
+        a = ChaosMonkey(self._cfg(), horizon_s=60.0)
+        b = ChaosMonkey(self._cfg(seed=14), horizon_s=60.0)
+        assert a.schedule != b.schedule
+
+    def test_disabled_kinds_schedule_nothing(self):
+        m = ChaosMonkey(self._cfg(kill_interval_s=0.0,
+                                  torn_record_interval_s=0.0),
+                        horizon_s=60.0)
+        assert m.schedule == []
+
+    def test_counters_and_provider_on_registry(self):
+        from ape_x_dqn_tpu.obs.registry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        m = ChaosMonkey(self._cfg(), registry=reg, horizon_s=10.0)
+        # No pool attached: a kill is executed as a recorded skip, still
+        # counted — chaos accounting must never silently drop an event.
+        m.execute("kill")
+        snap = reg.snapshot()
+        assert snap["chaos/kill"]["total"] == 1.0
+        assert snap["chaos"]["executed"] == 1
+        assert m.counts() == {"kill": 1}

@@ -640,3 +640,212 @@ class TestPipelineIntegration:
         assert_same_state(rep.state_dict(), rep2.state_dict())
         assert load_replay_leg(str(tmp_path / "nope"),
                                PrioritizedReplay(64, OBS)) is None
+
+
+# ---------------------------------------------------------------------------
+# Restore under corruption (ISSUE 6 satellite): flip one byte / truncate
+# each chunk kind — base, delta, manifest-missing — across all five replay
+# flavors, and assert EITHER exact recovery (the live generation's longest
+# good prefix, or the previous committed generation) OR a typed failure.
+# Never a wrong-data load, never a raw struct/zlib traceback.
+# ---------------------------------------------------------------------------
+
+
+def _make_fused(n=1):
+    import jax
+    import jax.numpy as jnp
+
+    from ape_x_dqn_tpu.learner.train_step import (
+        init_train_state,
+        make_optimizer,
+    )
+    from ape_x_dqn_tpu.models.dueling import DuelingMLP
+    from ape_x_dqn_tpu.runtime.fused_dedup import FusedDedupLearner
+
+    mesh = None
+    if n > 1:
+        from ape_x_dqn_tpu.parallel import make_mesh
+
+        mesh = make_mesh(num_devices=n)
+    net = DuelingMLP(num_actions=3, hidden_sizes=(16,))
+    opt = make_optimizer("adam", learning_rate=1e-3)
+    state = init_train_state(
+        net, opt, jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.uint8)
+    )
+    return FusedDedupLearner(
+        net, opt, state, (8,), capacity=64 * n, batch_size=4 * n,
+        steps_per_call=2, ingest_block=8 * n, target_sync_freq=4,
+        mesh=mesh,
+    )
+
+
+def _fused_feed(n):
+    def feed(fused, k):
+        for src in range(n):
+            fused.add_chunk(
+                prio(seed=src * 31 + k),
+                dchunk(src=src + 1, seq=k, seed=src * 31 + k,
+                       carry=2 if k else 0, obs=(8,)),
+            )
+        fused.ingest_staged(drain=True)
+    return feed
+
+
+def _dedup_feed(make_chunk=dchunk):
+    def feed(rep, k):
+        rep.add(prio(seed=k), make_chunk(src=1, seq=k, seed=k,
+                                         carry=2 if k else 0))
+        churn(rep, seed=k, B=2)
+    return feed
+
+
+def _np_feed(rep, k):
+    rep.add(prio(16, seed=k), np_chunk(16, seed=k))
+    churn(rep, seed=k)
+
+
+def _flavor(name):
+    """(make_fn, feed_fn) per replay flavor; skips where unavailable."""
+    if name == "prioritized":
+        return (lambda: PrioritizedReplay(64, OBS)), _np_feed
+    if name == "dedup":
+        return (lambda: DedupReplay(64, OBS, frame_ratio=1.25)), _dedup_feed()
+    if name == "native_dedup":
+        from ape_x_dqn_tpu.replay.native_dedup import (
+            NativeDedupReplay,
+            native_dedup_available,
+            native_dedup_error,
+        )
+
+        if not native_dedup_available():
+            pytest.skip(f"native core unavailable: {native_dedup_error()}")
+        return (lambda: NativeDedupReplay(64, OBS, frame_ratio=1.25)), \
+            _dedup_feed()
+    if name == "fused_dp1":
+        return (lambda: _make_fused(1)), _fused_feed(1)
+    if name == "fused_dp2":
+        return (lambda: _make_fused(2)), _fused_feed(2)
+    raise ValueError(name)
+
+
+FLAVORS = ["prioritized", "dedup", "native_dedup", "fused_dp1", "fused_dp2"]
+
+
+class TestRestoreUnderCorruption:
+    def _chain(self, root, make, feed, saves=6, base_every=2):
+        """Build a two-generation chain; returns per-save state snapshots
+        (materialized copies — the live buffers keep mutating) and the
+        final manifest."""
+        rep = make()
+        ck = IncrementalCheckpointer(str(root), rep, base_every=base_every,
+                                     sync=True)
+        states = {}
+        for k in range(saves):
+            feed(rep, k)
+            ck.save(k + 1)
+            states[k + 1] = {
+                key: np.array(np.asarray(v))
+                for key, v in rep.state_dict().items()
+            }
+        manifest = ci.read_manifest(ci.inc_dir(str(root)))
+        assert manifest["generation"] >= 1, "chain must span 2 generations"
+        assert manifest["chunk_steps"], "manifest must carry per-chunk steps"
+        return states, manifest
+
+    def _corrupt(self, root, chunk_name, mode):
+        path = os.path.join(ci.inc_dir(str(root)), chunk_name)
+        if mode == "bitflip":
+            with open(path, "r+b") as f:
+                f.seek(40)
+                b = f.read(1)
+                f.seek(40)
+                f.write(bytes([b[0] ^ 0x20]))
+        else:  # truncate to header-only
+            with open(path, "r+b") as f:
+                f.truncate(20)
+        return path
+
+    @pytest.mark.parametrize("flavor", FLAVORS)
+    @pytest.mark.parametrize("mode", ["bitflip", "truncate"])
+    def test_corrupt_delta_exact_prefix_recovery_or_typed(
+            self, tmp_path, flavor, mode):
+        make, feed = _flavor(flavor)
+        root = tmp_path / f"{flavor}-{mode}-delta"
+        states, manifest = self._chain(root, make, feed)
+        self._corrupt(root, manifest["chunks"][-1], mode)
+        # Without fallback: typed failure, never a raw decode error.
+        with pytest.raises(ChunkCorrupt) as ei:
+            load_incremental_replay(str(root), make())
+        assert ei.value.generation == manifest["generation"]
+        # With fallback: EXACT recovery to the previous delta's step.
+        rep2 = make()
+        step = load_incremental_replay(str(root), rep2, fallback=True)
+        want = manifest["chunk_steps"][-2]
+        assert step == want
+        assert_same_state(states[want], rep2.state_dict())
+        events = ci.consume_fallback_events()
+        assert events and events[-1]["fallback"] == "partial_chain"
+
+    @pytest.mark.parametrize("flavor", FLAVORS)
+    @pytest.mark.parametrize("mode", ["bitflip", "truncate"])
+    def test_corrupt_base_recovers_previous_generation_exactly(
+            self, tmp_path, flavor, mode):
+        make, feed = _flavor(flavor)
+        root = tmp_path / f"{flavor}-{mode}-base"
+        states, manifest = self._chain(root, make, feed)
+        self._corrupt(root, manifest["chunks"][0], mode)
+        with pytest.raises(ChunkCorrupt):
+            load_incremental_replay(str(root), make())
+        rep2 = make()
+        step = load_incremental_replay(str(root), rep2, fallback=True)
+        prev = ci.read_archived_manifest(
+            ci.inc_dir(str(root)), manifest["generation"] - 1
+        )
+        assert step == prev["step"]
+        assert_same_state(states[step], rep2.state_dict())
+        events = ci.consume_fallback_events()
+        assert events and events[-1]["fallback"] == "previous_generation"
+
+    @pytest.mark.parametrize("flavor", FLAVORS)
+    def test_manifest_missing_is_no_chain_not_wrong_data(
+            self, tmp_path, flavor):
+        make, feed = _flavor(flavor)
+        root = tmp_path / f"{flavor}-nomanifest"
+        self._chain(root, make, feed)
+        os.unlink(os.path.join(ci.inc_dir(str(root)), "MANIFEST.json"))
+        assert load_incremental_replay(str(root), make()) is None
+        assert load_incremental_replay(str(root), make(),
+                                       fallback=True) is None
+
+    def test_every_rung_corrupt_is_typed_failure(self, tmp_path):
+        make, feed = _flavor("prioritized")
+        root = tmp_path / "all-rungs"
+        _, manifest = self._chain(root, make, feed)
+        # Kill the live generation's base AND the archived generation's.
+        prev = ci.read_archived_manifest(
+            ci.inc_dir(str(root)), manifest["generation"] - 1
+        )
+        self._corrupt(root, manifest["chunks"][0], "bitflip")
+        self._corrupt(root, prev["chunks"][0], "truncate")
+        with pytest.raises(ChunkCorrupt):
+            load_incremental_replay(str(root), make(), fallback=True)
+        ci.consume_fallback_events()  # nothing restored; drain any noise
+
+    def test_pruning_retains_one_prior_generation(self, tmp_path):
+        make, feed = _flavor("prioritized")
+        root = tmp_path / "retention"
+        rep = make()
+        ck = IncrementalCheckpointer(str(root), rep, base_every=1, sync=True)
+        for k in range(8):  # many generations
+            feed(rep, k)
+            ck.save(k + 1)
+        manifest = ci.read_manifest(ci.inc_dir(str(root)))
+        live = manifest["generation"]
+        gens = sorted({
+            int(n.split("_")[1])
+            for n in os.listdir(ci.inc_dir(str(root)))
+            if n.startswith("chunk_")
+        })
+        # Exactly the live generation plus its fallback rung survive.
+        assert gens == [live - 1, live]
+        assert ci.read_archived_manifest(ci.inc_dir(str(root)), live - 1)

