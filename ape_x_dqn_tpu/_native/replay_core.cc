@@ -43,6 +43,8 @@
 // Build: g++ -O3 -shared -fPIC (replay/native_dedup.py, cached .so).
 
 #include <sys/mman.h>
+#include <sys/uio.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <atomic>
@@ -392,6 +394,243 @@ void rc_update_stripe(void* h, int32_t s_i, int64_t n, const int64_t* idx,
     double p = std::pow(std::max(static_cast<double>(prio[i]), 1e-12),
                         c->alpha);
     tree_set_one(s, leaf_of(*c, slot), p);
+  }
+}
+
+// ---- tiered frame store (replay/tiered.py SpanTierIndex) -------------
+// The cold tier keeps the frame mmap address-stable and moves BYTES only:
+// rc_evict_span copies a span out for the python-side cold write and
+// MADV_DONTNEEDs its pages (RSS released, reads become zero-fill);
+// rc_fault_span copies verified cold bytes back in.  Sampling splits in
+// two GIL-released calls — rc_sample_idx (descent + weights + metadata,
+// bit-identical law to rc_sample) so the wrapper can fault the spans the
+// batch actually needs, then rc_gather_frames for the two frame gathers.
+
+namespace {
+
+// zlib-compatible CRC-32 (reflected 0xEDB88320), slice-by-8 — the fault
+// batch verifies ~60 KB spans at memory speed instead of paying
+// python-side zlib calls per span.
+uint32_t crc_tab[8][256];
+bool crc_ready = false;
+
+void crc_init() {
+  if (crc_ready) return;
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t x = i;
+    for (int k = 0; k < 8; ++k)
+      x = (x & 1) ? 0xEDB88320u ^ (x >> 1) : x >> 1;
+    crc_tab[0][i] = x;
+  }
+  for (uint32_t i = 0; i < 256; ++i)
+    for (int s = 1; s < 8; ++s)
+      crc_tab[s][i] =
+          (crc_tab[s - 1][i] >> 8) ^ crc_tab[0][crc_tab[s - 1][i] & 0xFF];
+  crc_ready = true;
+}
+
+uint32_t crc32z(const uint8_t* p, size_t n) {
+  crc_init();
+  uint32_t crc = 0xFFFFFFFFu;
+  while (n && (reinterpret_cast<uintptr_t>(p) & 7)) {
+    crc = crc_tab[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+    --n;
+  }
+  while (n >= 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, p, 4);
+    std::memcpy(&hi, p + 4, 4);
+    crc ^= lo;
+    crc = crc_tab[7][crc & 0xFF] ^ crc_tab[6][(crc >> 8) & 0xFF] ^
+          crc_tab[5][(crc >> 16) & 0xFF] ^ crc_tab[4][crc >> 24] ^
+          crc_tab[3][hi & 0xFF] ^ crc_tab[2][(hi >> 8) & 0xFF] ^
+          crc_tab[1][(hi >> 16) & 0xFF] ^ crc_tab[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = crc_tab[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+void drop_pages(Core* c, int64_t slot, int64_t n) {
+  static const uintptr_t page = 4096;
+  uint8_t* lo = c->frames + slot * c->frame_bytes;
+  uint8_t* hi = lo + n * c->frame_bytes;
+  uint8_t* alo = reinterpret_cast<uint8_t*>(
+      (reinterpret_cast<uintptr_t>(lo) + page - 1) & ~(page - 1));
+  uint8_t* ahi = reinterpret_cast<uint8_t*>(
+      reinterpret_cast<uintptr_t>(hi) & ~(page - 1));
+  // Inner-aligned only: edge pages shared with neighbor spans keep their
+  // bytes (the copy-out above covered this span's own content).
+  if (ahi > alo) madvise(alo, ahi - alo, MADV_DONTNEED);
+}
+}  // namespace
+
+// Copy n frame slots starting at ring slot fstart (wrap-aware) into out,
+// then release the copied region's pages back to the OS.  The span's
+// content lives only in the caller's buffer afterwards — write it to the
+// cold store before dropping the reference.
+void rc_evict_span(void* h, int64_t fstart, int64_t n, uint8_t* out) {
+  Core* c = static_cast<Core*>(h);
+  int64_t slot = fstart % c->frame_capacity;
+  int64_t first = std::min(n, c->frame_capacity - slot);
+  std::memcpy(out, c->frames + slot * c->frame_bytes,
+              static_cast<size_t>(first) * c->frame_bytes);
+  drop_pages(c, slot, first);
+  if (first < n) {
+    std::memcpy(out + first * c->frame_bytes, c->frames,
+                static_cast<size_t>(n - first) * c->frame_bytes);
+    drop_pages(c, 0, n - first);
+  }
+}
+
+// Copy verified cold bytes back into the ring (the fault half).  Body is
+// rc_import_frames_span's; the separate export names the tier contract.
+void rc_fault_span(void* h, int64_t fstart, int64_t n,
+                   const uint8_t* frames) {
+  Core* c = static_cast<Core*>(h);
+  int64_t slot = fstart % c->frame_capacity;
+  int64_t first = std::min(n, c->frame_capacity - slot);
+  std::memcpy(c->frames + slot * c->frame_bytes, frames,
+              static_cast<size_t>(first) * c->frame_bytes);
+  if (first < n)
+    std::memcpy(c->frames, frames + first * c->frame_bytes,
+                static_cast<size_t>(n - first) * c->frame_bytes);
+}
+
+// Tiered rings opt OUT of transparent hugepages: the eviction cycle
+// MADV_DONTNEEDs sub-hugepage ranges, and every such drop on a THP
+// region splits a 2 MB page (measured ~10x the cost of a 4 KB-page
+// drop) — the gather's TLB win is repaid many times over in page-table
+// surgery.  Called once by the wrapper when a tier is attached.
+void rc_nohugepage(void* h) {
+  Core* c = static_cast<Core*>(h);
+  madvise(c->frames, c->frames_len, MADV_NOHUGEPAGE);
+}
+
+// Release a span's pages WITHOUT copying it out first — the clean-drop
+// eviction (disk record already current; rc_evict_span's copy would be
+// wasted work on the evictor thread).
+void rc_drop_span(void* h, int64_t fstart, int64_t n) {
+  Core* c = static_cast<Core*>(h);
+  int64_t slot = fstart % c->frame_capacity;
+  int64_t first = std::min(n, c->frame_capacity - slot);
+  drop_pages(c, slot, first);
+  if (first < n) drop_pages(c, 0, n - first);
+}
+
+// Batched cold fault, entirely GIL-released: for each span, pread the
+// record at `offsets[i]` from the spill file's fd straight into the ring
+// (span regions are span-aligned, so they never wrap), then verify
+// framing + self-CRC + the caller's expected content CRC over the landed
+// bytes.  Returns -1 when every span verified, else the index of the
+// first failing span (its ring bytes may be partial, but the caller only
+// marks spans resident on success, so a failed fault is retried — and
+// fails typed — on the next access).  Record layout must match
+// replay/tiered.py ColdSpanStore: "APXS" | u32 version | u64 span_id |
+// u64 payload_len | u32 crc32.
+int64_t rc_fault_batch(void* h, int32_t fd, int64_t n,
+                       const int64_t* offsets, const int64_t* fstarts,
+                       const int64_t* nframes, const int64_t* span_ids,
+                       const int64_t* want_crcs) {
+  Core* c = static_cast<Core*>(h);
+  uint8_t hdr[28];
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t want_len = static_cast<uint64_t>(nframes[i]) * c->frame_bytes;
+    uint8_t* dst =
+        c->frames + (fstarts[i] % c->frame_capacity) * c->frame_bytes;
+    // One syscall per span: header scatters into hdr, payload lands
+    // straight in the ring (span regions are span-aligned — no wrap).
+    struct iovec iov[2];
+    iov[0].iov_base = hdr;
+    iov[0].iov_len = 28;
+    iov[1].iov_base = dst;
+    iov[1].iov_len = want_len;
+    if (preadv(fd, iov, 2, offsets[i]) !=
+        static_cast<ssize_t>(28 + want_len))
+      return i;
+    if (std::memcmp(hdr, "APXS", 4) != 0) return i;
+    uint32_t version, crc;
+    uint64_t sid, plen;
+    std::memcpy(&version, hdr + 4, 4);
+    std::memcpy(&sid, hdr + 8, 8);
+    std::memcpy(&plen, hdr + 16, 8);
+    std::memcpy(&crc, hdr + 24, 4);
+    if (version != 1) return i;
+    if (static_cast<int64_t>(sid) != span_ids[i]) return i;
+    if (plen != want_len) return i;
+    uint32_t actual = crc32z(dst, plen);
+    if (actual != crc) return i;
+    if (want_crcs[i] >= 0 && actual != static_cast<uint32_t>(want_crcs[i]))
+      return i;
+  }
+  return -1;
+}
+
+// rc_sample minus the frame memcpys, plus each row's frame seqs so the
+// wrapper knows which spans to fault.  Same striped descent, same
+// uniforms, same weight arithmetic — rc_sample_idx + rc_gather_frames on
+// an all-hot ring is bit-identical to one rc_sample call (tests pin it).
+int32_t rc_sample_idx(void* h, int64_t B, double beta, const double* u,
+                      int64_t* out_idx, double* out_weights,
+                      int64_t* out_obs_seq, int64_t* out_next_seq,
+                      int32_t* out_action, float* out_reward,
+                      float* out_discount) {
+  Core* c = static_cast<Core*>(h);
+  if (B % c->n_stripes) return -2;
+  int64_t size = std::min(c->count, c->capacity);
+  if (size == 0) return -1;
+  int64_t Bk = B / c->n_stripes;
+  double wmax = 0.0;
+  for (int s_i = 0; s_i < c->n_stripes; ++s_i) {
+    Stripe& s = c->stripes[s_i];
+    std::lock_guard<std::mutex> g(s.mu);
+    double total = s.tree[1];
+    if (total <= 0) return -1;
+    double bounds = total / Bk;
+    double clip = std::nextafter(total, 0.0);
+    for (int64_t j = 0; j < Bk; ++j) {
+      double target = (j + u[s_i * Bk + j]) * bounds;
+      target = std::min(std::max(target, 0.0), clip);
+      int64_t leaf = tree_descend(s, target);
+      int64_t slot = leaf * c->n_stripes + s_i;
+      if (slot >= c->capacity)
+        slot = c->capacity - 1 - ((c->capacity - 1 - s_i) % c->n_stripes);
+      int64_t k = s_i * Bk + j;
+      out_idx[k] = slot;
+      double mass = s.tree[s.leaf_base + leaf_of(*c, slot)];
+      double q0 = std::max(mass / total, 1e-12);
+      double w = std::pow(static_cast<double>(size) * q0 / c->n_stripes,
+                          -beta);
+      out_weights[k] = w;
+      if (w > wmax) wmax = w;
+    }
+  }
+  for (int64_t k = 0; k < B; ++k) {
+    out_weights[k] /= wmax;
+    int64_t slot = out_idx[k];
+    out_obs_seq[k] = c->obs_seq[slot];
+    out_next_seq[k] = c->next_seq[slot];
+    out_action[k] = c->action[slot];
+    out_reward[k] = c->reward[slot];
+    out_discount[k] = c->discount[slot];
+  }
+  return 0;
+}
+
+// Second half of the two-phase sample: both frame gathers for the given
+// transition slots (the wrapper faulted their spans hot first).
+void rc_gather_frames(void* h, int64_t B, const int64_t* idx,
+                      uint8_t* out_obs, uint8_t* out_next) {
+  Core* c = static_cast<Core*>(h);
+  for (int64_t k = 0; k < B; ++k) {
+    int64_t slot = idx[k];
+    int64_t of = c->obs_seq[slot] % c->frame_capacity;
+    int64_t nf = c->next_seq[slot] % c->frame_capacity;
+    std::memcpy(out_obs + k * c->frame_bytes,
+                c->frames + of * c->frame_bytes, c->frame_bytes);
+    std::memcpy(out_next + k * c->frame_bytes,
+                c->frames + nf * c->frame_bytes, c->frame_bytes);
   }
 }
 

@@ -211,6 +211,28 @@ class ReplayConfig:
     # oldest transitions become unsampleable early (gracefully).
     dedup: bool = False
     frame_ratio: float = 1.25
+    # Tiered frame store (replay/tiered.py): > 0 caps the frame bytes held
+    # in DRAM — least-recently-sampled frame spans spill to a CRC-framed
+    # cold file and fault back on sample, while the sum-tree and every
+    # transition column stay hot (sampling law untouched).  This is how
+    # 10M+ slot replays run on commodity hosts (ROADMAP item 6: the 2M
+    # dedup layout already pins 17.6 GB).  0 disables — the replays
+    # allocate their dense rings exactly as before, zero cost when off.
+    # Host-replay path only (the fused HBM ring is its own tier).
+    hot_frame_budget_bytes: int = 0
+    # Spill-file directory.  "auto" = <learner.checkpoint_dir>/replay_spill
+    # when checkpointing is on (incremental bases then reference cold
+    # spans by offset into a dir the run already owns), else a per-pid
+    # tempdir.  An explicit path is used as given.
+    spill_dir: str = "auto"
+    # Frames per spill span (the eviction/fault granule).  0 = auto-size
+    # to ~64 KiB payloads — big enough to amortize record framing + CRC,
+    # small enough that one sample batch faults MBs, not GBs.
+    spill_span_frames: int = 0
+    # Eviction hysteresis, as fractions of the hot budget: the background
+    # evictor wakes past high x budget and trims to low x budget.
+    spill_watermark_high: float = 1.0
+    spill_watermark_low: float = 0.9
 
 
 @dataclasses.dataclass
@@ -472,6 +494,21 @@ class ApexConfig:
              "actors per fleet (per worker in process mode) — each fleet "
              "splits into one dedup stream per ring shard"),
             (r.frame_ratio > 0, "replay.frame_ratio must be positive"),
+            (r.hot_frame_budget_bytes >= 0,
+             "replay.hot_frame_budget_bytes must be >= 0"),
+            (not (r.hot_frame_budget_bytes and r.frame_compression),
+             "replay.hot_frame_budget_bytes and replay.frame_compression "
+             "are mutually exclusive (the cold tier spans raw frame "
+             "bytes; compressed slots are per-slot python objects)"),
+            (not (r.hot_frame_budget_bytes and l.device_replay),
+             "replay.hot_frame_budget_bytes requires device_replay=False "
+             "(the tier spills the HOST frame ring; the HBM ring is its "
+             "own tier)"),
+            (r.spill_span_frames >= 0,
+             "replay.spill_span_frames must be >= 0"),
+            (0.0 < r.spill_watermark_low <= r.spill_watermark_high <= 1.0,
+             "replay spill watermarks must satisfy "
+             "0 < low <= high <= 1"),
             (0.0 <= r.is_exponent <= 1.0, "replay.is_exponent must be in [0, 1]"),
             (self.network in ("conv", "nature", "mlp"),
              f"unknown network kind: {self.network}"),

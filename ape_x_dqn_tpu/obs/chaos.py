@@ -163,13 +163,11 @@ class ShmFiller:
         self._seg = None
 
     def fill(self, nbytes: int) -> dict:
-        from multiprocessing import shared_memory
-
         self.release()
         try:
-            self._seg = shared_memory.SharedMemory(
-                create=True, size=max(1, int(nbytes))
-            )
+            from ape_x_dqn_tpu.runtime.shm_ring import create_shared_memory
+
+            self._seg = create_shared_memory("chaosfill", max(1, int(nbytes)))
             # Touch the pages so tmpfs actually commits them.
             self._seg.buf[::4096] = b"\xff" * len(self._seg.buf[::4096])
             return {"fault": "shm_fill", "bytes": int(nbytes),

@@ -107,7 +107,9 @@ class WorkerStatsBlock:
                 raise ValueError("event_depth must be >= 1")
             size = (_HEADER_SIZE + _NAMES_SIZE + 8 * len(names)
                     + depth * _EVENT_SLOT)
-            self._shm = shared_memory.SharedMemory(create=True, size=size)
+            from ape_x_dqn_tpu.runtime.shm_ring import create_shared_memory
+
+            self._shm = create_shared_memory("stats", size)
             self._shm.buf[:size] = b"\x00" * size
             _IDENT.pack_into(self._shm.buf, 0, _MAGIC, _VERSION,
                              len(names), depth)

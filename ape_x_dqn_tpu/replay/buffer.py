@@ -50,6 +50,8 @@ class RawFrameStore:
 
     def __init__(self, capacity: int, frame_shape, dtype=np.uint8):
         self._arr = np.zeros((capacity, *frame_shape), dtype=dtype)
+        self.shape = tuple(frame_shape)
+        self.dtype = np.dtype(dtype)
 
     def encode(self, frames: np.ndarray):
         return frames
@@ -66,6 +68,51 @@ class RawFrameStore:
 
     def nbytes(self) -> int:
         return self._arr.nbytes
+
+
+class TieredFrameStore:
+    """Frame store over a ``TieredFrameRing`` (replay/tiered.py): the
+    double-store's answer to ``replay.hot_frame_budget_bytes``.  Slot
+    indices map 1:1 onto ring slots; least-recently-sampled spans spill
+    to the CRC-framed cold file and fault back on ``get``.
+
+    Snapshots still materialize through ``get`` (the double-store has no
+    cold-ref checkpoint leg — that optimization lives on the dedup path,
+    where paper-scale rings are); the tier here is purely a DRAM cap on
+    the live buffer.
+    """
+
+    compressed = False
+
+    def __init__(self, capacity: int, frame_shape, dtype=np.uint8, *,
+                 hot_budget_bytes: int, spill_path: str,
+                 span_frames: int = 0, watermark_high: float = 1.0,
+                 watermark_low: float = 0.9):
+        from ape_x_dqn_tpu.replay.tiered import TieredFrameRing
+
+        self.ring = TieredFrameRing(
+            capacity, frame_shape, dtype=dtype,
+            hot_budget_bytes=hot_budget_bytes, spill_path=spill_path,
+            span_frames=span_frames, watermark_high=watermark_high,
+            watermark_low=watermark_low,
+        )
+        self.shape = self.ring.frame_shape
+        self.dtype = self.ring.dtype
+
+    def encode(self, frames: np.ndarray):
+        return frames
+
+    def put_encoded(self, idx: np.ndarray, encoded) -> None:
+        self.ring.put(np.asarray(idx, np.int64), encoded)
+
+    def put(self, idx: np.ndarray, frames: np.ndarray) -> None:
+        self.put_encoded(idx, self.encode(frames))
+
+    def get(self, idx: np.ndarray) -> np.ndarray:
+        return self.ring.get(np.asarray(idx, np.int64))
+
+    def nbytes(self) -> int:
+        return self.ring.hot_bytes
 
 
 class CompressedFrameStore:
@@ -164,6 +211,11 @@ class PrioritizedReplay:
         obs_dtype=np.uint8,
         sum_tree_cls=None,
         frame_compression: bool = False,
+        hot_frame_budget_bytes: int = 0,
+        spill_dir=None,
+        spill_span_frames: int = 0,
+        spill_watermark_high: float = 1.0,
+        spill_watermark_low: float = 0.9,
     ):
         if sum_tree_cls is None:
             from ape_x_dqn_tpu.replay.native import default_sum_tree_cls
@@ -173,9 +225,40 @@ class PrioritizedReplay:
             raise ValueError("capacity must be positive")
         self.capacity = int(capacity)
         self.alpha = float(priority_exponent)
-        store_cls = CompressedFrameStore if frame_compression else RawFrameStore
-        self._obs = store_cls(capacity, obs_shape, obs_dtype)
-        self._next_obs = store_cls(capacity, obs_shape, obs_dtype)
+        if hot_frame_budget_bytes > 0:
+            # Tiered double-store: obs and next_obs each get half the hot
+            # budget and their own spill file (config.py
+            # replay.hot_frame_budget_bytes; mutually exclusive with
+            # frame_compression at validation).
+            import os
+
+            if frame_compression:
+                raise ValueError(
+                    "hot_frame_budget_bytes and frame_compression are "
+                    "mutually exclusive"
+                )
+            if spill_dir is None:
+                raise ValueError("tiered replay needs a spill_dir")
+            half = max(1, int(hot_frame_budget_bytes) // 2)
+            tier_kw = dict(
+                span_frames=spill_span_frames,
+                watermark_high=spill_watermark_high,
+                watermark_low=spill_watermark_low,
+            )
+            self._obs = TieredFrameStore(
+                capacity, obs_shape, obs_dtype, hot_budget_bytes=half,
+                spill_path=os.path.join(spill_dir, "obs.cold"), **tier_kw,
+            )
+            self._next_obs = TieredFrameStore(
+                capacity, obs_shape, obs_dtype, hot_budget_bytes=half,
+                spill_path=os.path.join(spill_dir, "next_obs.cold"),
+                **tier_kw,
+            )
+        else:
+            store_cls = (CompressedFrameStore if frame_compression
+                         else RawFrameStore)
+            self._obs = store_cls(capacity, obs_shape, obs_dtype)
+            self._next_obs = store_cls(capacity, obs_shape, obs_dtype)
         self._action = np.zeros((capacity,), dtype=np.int32)
         self._reward = np.zeros((capacity,), dtype=np.float32)
         self._discount = np.zeros((capacity,), dtype=np.float32)
@@ -290,6 +373,45 @@ class PrioritizedReplay:
             # Overflow guard: the sparse record would rival a full
             # snapshot — drop tracking, the next delta becomes a base.
             self._dirty, self._dirty_rows, self._ckpt = [], 0, None
+
+    # -- cold tier surface (replay/tiered.py; no-ops when tier is off) ---
+
+    @property
+    def tier(self):
+        return getattr(self._obs, "ring", None)
+
+    def tier_over_watermark(self) -> bool:
+        ring = getattr(self._obs, "ring", None)
+        if ring is None:
+            return False
+        return (ring.over_high_watermark()
+                or self._next_obs.ring.over_high_watermark())
+
+    def spill_cold(self, max_spans: int = 0) -> tuple:
+        """Evict least-recently-sampled spans in both stores down to their
+        low watermarks (TierEvictor's entry point)."""
+        if getattr(self._obs, "ring", None) is None:
+            return 0, 0
+        with self._lock:
+            s1, b1 = self._obs.ring.spill(max_spans=max_spans)
+            s2, b2 = self._next_obs.ring.spill(max_spans=max_spans)
+            return s1 + s2, b1 + b2
+
+    def tier_stats(self) -> Optional[dict]:
+        ring = getattr(self._obs, "ring", None)
+        if ring is None:
+            return None
+        with self._lock:
+            a, b = ring.tier_stats(), self._next_obs.ring.tier_stats()
+        out = {}
+        for k in a:
+            if k == "fault_ms":
+                out[k] = a[k] if a[k]["count"] else b[k]
+            elif k == "span_frames":
+                out[k] = a[k]
+            else:
+                out[k] = a[k] + b[k]
+        return out
 
     # -- misc ------------------------------------------------------------
 
@@ -469,8 +591,8 @@ class PrioritizedReplay:
             elif compressed_snap:
                 # Cross-restore into a raw store: inflate through a scratch
                 # compressed view.
-                tmp = CompressedFrameStore(size, self._obs._arr.shape[1:],
-                                           self._obs._arr.dtype)
+                tmp = CompressedFrameStore(size, self._obs.shape,
+                                           self._obs.dtype)
                 tmp.import_blobs(state["obs_blob"], state["obs_lens"])
                 self._obs.put(rng, tmp.get(rng))
                 tmp.import_blobs(state["next_obs_blob"], state["next_obs_lens"])

@@ -125,6 +125,11 @@ class DedupReplay:
         obs_dtype=np.uint8,
         sum_tree_cls=None,
         frame_ratio: float = 1.25,
+        hot_frame_budget_bytes: int = 0,
+        spill_dir: Optional[str] = None,
+        spill_span_frames: int = 0,
+        spill_watermark_high: float = 1.0,
+        spill_watermark_low: float = 0.9,
     ):
         if sum_tree_cls is None:
             from ape_x_dqn_tpu.replay.native import default_sum_tree_cls
@@ -137,7 +142,34 @@ class DedupReplay:
         self.capacity = int(capacity)
         self.frame_capacity = max(1, int(round(capacity * frame_ratio)))
         self.alpha = float(priority_exponent)
-        self._frames = np.zeros((self.frame_capacity, *obs_shape), obs_dtype)
+        # Tiered frame store (replay/tiered.py): a positive hot budget
+        # replaces the dense frame ring with a hot span cache over a
+        # CRC-framed cold spill file.  Only the frame BYTES tier — the
+        # sum-tree, liveness, and every transition column stay hot, so
+        # the sampling law and update_priorities are untouched.  Off
+        # (the default) this branch allocates the dense ndarray exactly
+        # as before: zero cost when disabled.
+        self._tier = None
+        if hot_frame_budget_bytes > 0:
+            import os
+
+            from ape_x_dqn_tpu.replay.tiered import TieredFrameRing
+
+            if spill_dir is None:
+                raise ValueError("tiered replay needs a spill_dir")
+            self._tier = TieredFrameRing(
+                self.frame_capacity, obs_shape, dtype=obs_dtype,
+                hot_budget_bytes=hot_frame_budget_bytes,
+                spill_path=os.path.join(spill_dir, "frames.cold"),
+                span_frames=spill_span_frames,
+                watermark_high=spill_watermark_high,
+                watermark_low=spill_watermark_low,
+            )
+            self._frames = None
+        else:
+            self._frames = np.zeros(
+                (self.frame_capacity, *obs_shape), obs_dtype
+            )
         self._obs_seq = np.zeros((capacity,), np.int64)
         self._next_seq = np.zeros((capacity,), np.int64)
         self._action = np.zeros((capacity,), np.int32)
@@ -184,8 +216,12 @@ class DedupReplay:
             obs_seq, next_seq, keep = self._resolver.resolve(chunk, base)
             # Frames land regardless of dropped rows (the NEXT chunk's
             # carry refs point into them).
-            fidx = (base + np.arange(U)) % self.frame_capacity
-            self._frames[fidx] = chunk.frames
+            if self._tier is not None:
+                self._tier.put_span(base % self.frame_capacity, U,
+                                    chunk.frames)
+            else:
+                fidx = (base + np.arange(U)) % self.frame_capacity
+                self._frames[fidx] = chunk.frames
             self._fcount = base + U
             m = int(keep.sum())
             idx = np.zeros(0, np.int64)
@@ -229,6 +265,49 @@ class DedupReplay:
             # Overflow guard: sparse record rivals a base — retrack.
             self._dirty, self._dirty_rows, self._ckpt = [], 0, None
 
+    def _fgather(self, seqs: np.ndarray) -> np.ndarray:
+        """Frame gather by sequence number — the ONE indirection the tier
+        adds to the sample path (cold spans fault here)."""
+        slots = np.asarray(seqs, np.int64) % self.frame_capacity
+        if self._tier is not None:
+            return self._tier.get(slots)
+        return self._frames[slots]
+
+    # -- cold tier surface (replay/tiered.py; no-ops when tier is off) ---
+
+    @property
+    def tier(self):
+        return self._tier
+
+    def tier_over_watermark(self) -> bool:
+        """Lock-free evictor poll: a stale read only delays one batch."""
+        return self._tier is not None and self._tier.over_high_watermark()
+
+    def spill_cold(self, max_spans: int = 0, target_bytes=None) -> tuple:
+        """Evict least-recently-sampled spans down to the low watermark
+        (TierEvictor's entry point — one bounded batch per lock hold).
+        ``target_bytes`` overrides the watermark (0 = spill everything —
+        bench/drain tooling)."""
+        if self._tier is None:
+            return 0, 0
+        with self._lock:
+            return self._tier.spill(max_spans=max_spans,
+                                    target_bytes=target_bytes)
+
+    def tier_flush_dirty(self) -> int:
+        """Write-back every dirty hot span's cold record (residency kept)
+        under the replay lock — pre-trim/pre-bench hygiene."""
+        if self._tier is None:
+            return 0
+        with self._lock:
+            return self._tier.flush_dirty()
+
+    def tier_stats(self) -> Optional[dict]:
+        if self._tier is None:
+            return None
+        with self._lock:
+            return self._tier.tier_stats()
+
     # -- read path (learner) --------------------------------------------
 
     def sample(
@@ -249,11 +328,11 @@ class DedupReplay:
             mass = self._tree.get(idx)
             total = self._tree.total
             transition = NStepTransition(
-                obs=self._frames[self._obs_seq[idx] % self.frame_capacity],
+                obs=self._fgather(self._obs_seq[idx]),
                 action=self._action[idx].copy(),
                 reward=self._reward[idx].copy(),
                 discount=self._discount[idx].copy(),
-                next_obs=self._frames[self._next_seq[idx] % self.frame_capacity],
+                next_obs=self._fgather(self._next_seq[idx]),
             )
         probs = mass / total
         weights = np.power(size * np.maximum(probs, 1e-12), -beta)
@@ -304,8 +383,12 @@ class DedupReplay:
         return self._count
 
     def frames_nbytes(self) -> int:
-        """Bytes held by frame storage — the dedup win's observable
-        (compare: the double-store's 2 × capacity × frame_bytes)."""
+        """Bytes held by frame storage in DRAM — the dedup win's observable
+        (compare: the double-store's 2 × capacity × frame_bytes).  Tiered,
+        this is the HOT bytes only — the number the hot budget caps."""
+        if self._tier is not None:
+            with self._lock:
+                return self._tier.hot_bytes
         return self._frames.nbytes
 
     def max_priority(self) -> float:
@@ -319,14 +402,14 @@ class DedupReplay:
         with self._lock:
             return self._state_dict_locked()
 
-    def _state_dict_locked(self) -> dict:
+    def _state_dict_locked(self, cold_refs: bool = False) -> dict:
         size = min(self._count, self.capacity)
         idx = np.arange(size)
         nf = min(self._fcount, self.frame_capacity)
         src_ids, src_state = self._resolver.state_arrays()
-        return {
+        out = {
             "dedup": np.asarray(True),
-            "frames": self._frames[:nf].copy(),
+            "frames": None,  # filled below (dense or tier cold refs)
             "obs_seq": self._obs_seq[:size].copy(),
             "next_seq": self._next_seq[:size].copy(),
             "action": self._action[:size].copy(),
@@ -343,6 +426,25 @@ class DedupReplay:
             "src_ids": src_ids,
             "src_state": src_state,
         }
+        # Frame leg.  Dense (or tier-but-nothing-cold): the legacy "frames"
+        # array.  cold_refs=True with cold spans: the tiered base format —
+        # hot frames inline, cold spans referenced by (offset, len, crc)
+        # into the spill file instead of being paged back in (the
+        # checkpoint_inc "mostly-cold base must not re-read the cold
+        # tier" contract).  state_dict() keeps cold_refs=False: the
+        # public full snapshot always materializes (oracle comparisons,
+        # legacy npz path).
+        refs = None
+        if cold_refs and self._tier is not None:
+            refs = self._tier.cold_refs(nf)
+        if refs is not None:
+            del out["frames"]
+            out.update(refs)
+        elif self._tier is not None:
+            out["frames"] = self._tier.get_span(0, nf)
+        else:
+            out["frames"] = self._frames[:nf].copy()
+        return out
 
     # -- incremental snapshot (utils/checkpoint_inc delta protocol) -------
 
@@ -358,7 +460,9 @@ class DedupReplay:
             f_new = self._fcount - (prev[2] if prev else 0)
             if (force_base or prev is None or n_new >= self.capacity
                     or f_new >= self.frame_capacity):
-                out = self._state_dict_locked()
+                # Base snapshots reference cold spans by offset (tiered) —
+                # a mostly-cold ring must not be paged back in to save.
+                out = self._state_dict_locked(cold_refs=True)
                 out["chain_mark"] = np.asarray(
                     [self._count, self._fcount], np.int64
                 )
@@ -385,7 +489,13 @@ class DedupReplay:
                 "span_alive": self._alive[span].copy(),
                 "span_tree": self._tree.get(span),
                 "fspan_idx": fspan,
-                "fspan_frames": self._frames[fspan].copy(),
+                "fspan_frames": (
+                    self._tier.get_span(
+                        prev_fcount % self.frame_capacity, f_new
+                    )
+                    if self._tier is not None
+                    else self._frames[fspan].copy()
+                ),
                 "prio_idx": dirty,
                 "prio_mass": self._tree.get(dirty),
                 "prio_alive": self._alive[dirty].copy(),
@@ -430,7 +540,12 @@ class DedupReplay:
                 )
             span = np.asarray(delta["span_idx"], np.int64)
             fspan = np.asarray(delta["fspan_idx"], np.int64)
-            self._frames[fspan] = delta["fspan_frames"]
+            if self._tier is not None:
+                if fspan.size:
+                    self._tier.put_span(int(fspan[0]), fspan.size,
+                                        delta["fspan_frames"])
+            else:
+                self._frames[fspan] = delta["fspan_frames"]
             self._obs_seq[span] = delta["span_obs_seq"]
             self._next_seq[span] = delta["span_next_seq"]
             self._action[span] = delta["span_action"]
@@ -474,12 +589,12 @@ class DedupReplay:
                 np.arange(self.capacity), np.zeros(self.capacity)
             )
             self._alive[:] = False
-            nf = state["frames"].shape[0]
             self._fcount = int(state["fcount"])
+            nf = min(self._fcount, self.frame_capacity)
             # Snapshot frames are SLOT-ordered [0, nf): identity placement
             # (seq % capacity addressing is stable across save/restore
             # because frame_capacity is layout-checked above).
-            self._frames[:nf] = state["frames"]
+            self._load_frames_locked(state, nf)
             rng = np.arange(size)
             self._obs_seq[:size] = state["obs_seq"]
             self._next_seq[:size] = state["next_seq"]
@@ -498,3 +613,70 @@ class DedupReplay:
                 state["src_ids"], state["src_state"]
             )
             self._ckpt, self._dirty, self._dirty_rows = None, [], 0
+
+    def _load_frames_locked(self, state: dict, nf: int) -> None:
+        """Frame leg of a full restore: dense snapshots land as before;
+        tiered (cold-ref) bases either ADOPT the spill file in place —
+        verify each referenced record, O(hot bytes) restored — or
+        materialize through ``read_cold_refs_dense`` when this replay
+        has no compatible tier.  Either way every cold byte is CRC- and
+        content-verified; a torn record raises the typed
+        ``ColdSpanCorrupt`` the checkpoint fallback walk consumes."""
+        if "tier_hot_sids" not in state:
+            if self._tier is not None:
+                self._tier.drop_all()
+                self._tier.put_span(0, nf, state["frames"][:nf])
+            else:
+                self._frames[:nf] = state["frames"][:nf]
+            return
+        from ape_x_dqn_tpu.replay.tiered import (
+            ColdSpanStore,
+            read_cold_refs_dense,
+        )
+
+        span_frames = int(
+            np.asarray(state["tier_span_frames"]).reshape(-1)[0]
+        )
+        tier_cap = int(np.asarray(state["tier_capacity"]).reshape(-1)[0])
+        if (self._tier is None
+                or self._tier.span_frames != span_frames
+                or self._tier.capacity != tier_cap):
+            dense = read_cold_refs_dense(state)
+            if self._tier is not None:
+                self._tier.drop_all()
+                self._tier.put_span(0, nf, dense[:nf])
+            else:
+                self._frames[:nf] = dense[:nf]
+            return
+        tier = self._tier
+        tier.drop_all()
+        path = bytes(
+            np.asarray(state["tier_spill_path"], np.uint8)
+        ).decode()
+        import os
+
+        same = (os.path.realpath(path)
+                == os.path.realpath(tier.store.path))
+        src = tier.store if same else ColdSpanStore(
+            path, tier.n_spans, tier.span_bytes
+        )
+        try:
+            hot_sids = np.asarray(state["tier_hot_sids"], np.int64)
+            hot_frames = np.asarray(state["tier_hot_frames"])
+            off = 0
+            for sid in hot_sids:
+                n = tier._span_len(int(sid))
+                tier.put_span(int(sid) * span_frames, n,
+                              hot_frames[off:off + n])
+                off += n
+            for sid, offset, length, crc in zip(
+                np.asarray(state["tier_cold_sids"], np.int64),
+                np.asarray(state["tier_cold_offsets"], np.int64),
+                np.asarray(state["tier_cold_lens"], np.int64),
+                np.asarray(state["tier_cold_crcs"], np.int64),
+            ):
+                tier.adopt_cold_ref(int(sid), int(offset), int(length),
+                                    int(crc), src)
+        finally:
+            if not same:
+                src.close()

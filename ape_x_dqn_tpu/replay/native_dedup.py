@@ -150,6 +150,33 @@ def _load():
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
                 ctypes.c_int64, ctypes.c_int64,
             ]
+            # Tiered frame store surface (replay/tiered.SpanTierIndex):
+            # evict/fault move span bytes without the GIL; the two-phase
+            # sample splits descent from the frame gathers so cold spans
+            # can fault in between.
+            lib.rc_evict_span.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, _u8p,
+            ]
+            lib.rc_fault_span.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, _u8p,
+            ]
+            lib.rc_sample_idx.restype = ctypes.c_int32
+            lib.rc_sample_idx.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_double, _f64p,
+                _i64p, _f64p, _i64p, _i64p, _i32p, _f32p, _f32p,
+            ]
+            lib.rc_gather_frames.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, _i64p, _u8p, _u8p,
+            ]
+            lib.rc_drop_span.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ]
+            lib.rc_nohugepage.argtypes = [ctypes.c_void_p]
+            lib.rc_fault_batch.restype = ctypes.c_int64
+            lib.rc_fault_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64,
+                _i64p, _i64p, _i64p, _i64p, _i64p,
+            ]
             _lib = lib
         except Exception as e:  # compiler missing, build/load failure
             _lib_err = f"{type(e).__name__}: {e}"
@@ -180,6 +207,11 @@ class NativeDedupReplay:
         obs_dtype=np.uint8,
         frame_ratio: float = 1.25,
         n_stripes: int = 1,
+        hot_frame_budget_bytes: int = 0,
+        spill_dir: Optional[str] = None,
+        spill_span_frames: int = 0,
+        spill_watermark_high: float = 1.0,
+        spill_watermark_low: float = 0.9,
     ):
         lib = _load()
         if lib is None:
@@ -201,6 +233,37 @@ class NativeDedupReplay:
             raise MemoryError("rc_create failed")
         self._resolver = CarryResolver()
         self._lock = threading.Lock()
+        # Tiered frame store (replay/tiered.py): the C mmap stays the
+        # address-stable hot storage; SpanTierIndex decides which spans are
+        # resident, spilling least-recently-sampled ones through
+        # rc_evict_span (copy out + MADV_DONTNEED — RSS actually drops)
+        # and faulting them back through rc_fault_span, all GIL-released.
+        # Sampling switches to the two-phase rc_sample_idx +
+        # rc_gather_frames so the needed spans fault between descent and
+        # gather; off (the default) every call below is byte-identical to
+        # the untiered build — zero cost when disabled.
+        self._tier = None
+        if hot_frame_budget_bytes > 0:
+            from ape_x_dqn_tpu.replay.tiered import SpanTierIndex
+
+            if spill_dir is None:
+                raise ValueError("tiered replay needs a spill_dir")
+            # THP off for tiered rings: span drops would split 2 MB pages
+            # on every eviction (see rc_nohugepage).
+            lib.rc_nohugepage(self._handle)
+            self._tier = SpanTierIndex(
+                self.frame_capacity, self.obs_shape, np.uint8,
+                hot_budget_bytes=hot_frame_budget_bytes,
+                spill_path=os.path.join(spill_dir, "frames.cold"),
+                read_fn=self._tier_read_span,
+                evict_fn=self._tier_evict_span,
+                fault_fn=self._tier_fault_span,
+                fault_batch_fn=self._tier_fault_batch,
+                drop_fn=self._tier_drop_span,
+                span_frames=spill_span_frames,
+                watermark_high=spill_watermark_high,
+                watermark_low=spill_watermark_low,
+            )
         # Persistent per-stripe fan-out pool (n_stripes > 1): one
         # GIL-released C call per stripe, dispatched concurrently — see
         # _sample_with_uniforms / update_priorities.  Lazy would race the
@@ -228,10 +291,79 @@ class NativeDedupReplay:
         pool = getattr(self, "_pool", None)
         if pool is not None:
             pool.shutdown(wait=False)
+        tier = getattr(self, "_tier", None)
+        if tier is not None:
+            tier.close()
         h = getattr(self, "_handle", None)
         if h:
             self._lib.rc_destroy(h)
             self._handle = None
+
+    # -- cold tier plumbing (SpanTierIndex callables + public surface) ----
+
+    def _tier_read_span(self, start: int, n: int) -> np.ndarray:
+        out = np.empty((n, *self.obs_shape), np.uint8)
+        self._lib.rc_export_frames_span(self._handle, int(start), int(n),
+                                        _p(out, _u8p))
+        return out
+
+    def _tier_evict_span(self, start: int, n: int) -> np.ndarray:
+        out = np.empty((n, *self.obs_shape), np.uint8)
+        self._lib.rc_evict_span(self._handle, int(start), int(n),
+                                _p(out, _u8p))
+        return out
+
+    def _tier_fault_span(self, start: int, n: int, frames) -> None:
+        blk = np.ascontiguousarray(frames, np.uint8)
+        self._lib.rc_fault_span(self._handle, int(start), int(n),
+                                _p(blk, _u8p))
+
+    def _tier_drop_span(self, start: int, n: int) -> None:
+        self._lib.rc_drop_span(self._handle, int(start), int(n))
+
+    def _tier_fault_batch(self, fd, offsets, fstarts, lens, sids,
+                          want_crcs) -> int:
+        return int(self._lib.rc_fault_batch(
+            self._handle, int(fd), offsets.shape[0],
+            _p(offsets, _i64p), _p(fstarts, _i64p), _p(lens, _i64p),
+            _p(sids, _i64p), _p(want_crcs, _i64p),
+        ))
+
+    @property
+    def tier(self):
+        return self._tier
+
+    def tier_over_watermark(self) -> bool:
+        return self._tier is not None and self._tier.over_high_watermark()
+
+    def spill_cold(self, max_spans: int = 0, target_bytes=None) -> tuple:
+        if self._tier is None:
+            return 0, 0
+        with self._lock:
+            return self._tier.spill(max_spans=max_spans,
+                                    target_bytes=target_bytes)
+
+    def tier_flush_dirty(self) -> int:
+        """Write-back every dirty hot span's cold record (residency kept)
+        under the replay lock — pre-trim/pre-bench hygiene."""
+        if self._tier is None:
+            return 0
+        with self._lock:
+            return self._tier.flush_dirty()
+
+    def tier_stats(self) -> Optional[dict]:
+        if self._tier is None:
+            return None
+        with self._lock:
+            return self._tier.tier_stats()
+
+    def _ensure_hot_all_locked(self) -> None:
+        """Materialize the full written frame region (public full
+        snapshots and legacy whole-ring exports)."""
+        nf = min(int(self._lib.rc_fcount(self._handle)),
+                 self.frame_capacity)
+        if nf:
+            self._tier.ensure_hot(self._tier.spans_of_run(0, nf))
 
     # -- write path ------------------------------------------------------
 
@@ -243,6 +375,11 @@ class NativeDedupReplay:
             raise ValueError("chunk exceeds ring capacity")
         with self._lock:
             base = int(self._lib.rc_fcount(self._handle))
+            if self._tier is not None:
+                # Cold spans the write only PARTIALLY covers fault first
+                # (rc_add memcpys into the mmap; a dropped span's other
+                # slots live only in the cold record).
+                self._tier.note_write(base % self.frame_capacity, U)
             obs_seq, next_seq, keep = self._resolver.resolve(chunk, base)
             obs_seq = np.ascontiguousarray(obs_seq[keep])
             next_seq = np.ascontiguousarray(next_seq[keep])
@@ -301,7 +438,31 @@ class NativeDedupReplay:
                 f"batch_size {B} must divide by n_stripes {self.n_stripes}"
             )
         with self._lock:
-            if self.n_stripes == 1:
+            if self._tier is not None:
+                # Two-phase tiered sample: descend + weights + metadata in
+                # one GIL-released call (bit-identical law to rc_sample,
+                # stripes included), fault the spans this batch actually
+                # references, then gather.  The stripe fan-out pool is
+                # bypassed — the fault step is inherently serial.
+                obs_seq = np.empty(B, np.int64)
+                next_seq = np.empty(B, np.int64)
+                rc = self._lib.rc_sample_idx(
+                    self._handle, B, float(beta), _p(u, _f64p),
+                    _p(idx, _i64p), _p(weights, _f64p),
+                    _p(obs_seq, _i64p), _p(next_seq, _i64p),
+                    _p(action, _i32p), _p(reward, _f32p),
+                    _p(discount, _f32p),
+                )
+                if rc == -1:
+                    raise ValueError("cannot sample from an empty replay")
+                slots = np.concatenate([obs_seq, next_seq]) \
+                    % self.frame_capacity
+                self._tier.ensure_hot(self._tier.spans_of_slots(slots))
+                self._lib.rc_gather_frames(
+                    self._handle, B, _p(idx, _i64p),
+                    _p(obs, _u8p), _p(next_obs, _u8p),
+                )
+            elif self.n_stripes == 1:
                 rc = self._lib.rc_sample(
                     self._handle, B, float(beta), _p(u, _f64p),
                     _p(idx, _i64p), _p(weights, _f64p), _p(obs, _u8p),
@@ -406,11 +567,25 @@ class NativeDedupReplay:
         with self._lock:
             return self._state_dict_locked()
 
-    def _state_dict_locked(self) -> dict:
+    def _state_dict_locked(self, cold_refs: bool = False) -> dict:
         size = self.size()
         nf = min(int(self._lib.rc_fcount(self._handle)),
                  self.frame_capacity)
-        frames = np.empty((nf, *self.obs_shape), np.uint8)
+        # Frame leg first: cold_refs=True on a tiered ring references cold
+        # spans by (offset, len, crc) into the spill file — a mostly-cold
+        # base must not page the whole ring back in just to checkpoint.
+        refs = None
+        if cold_refs and self._tier is not None:
+            refs = self._tier.cold_refs(nf)
+        if refs is None:
+            if self._tier is not None:
+                self._ensure_hot_all_locked()
+            frames = np.empty((nf, *self.obs_shape), np.uint8)
+            frames_p = _p(frames, _u8p)
+        else:
+            frames = None
+            # rc_export still wants a destination; rows come from
+            # rc_export_rows below instead, so skip it entirely.
         obs_seq = np.empty(size, np.int64)
         next_seq = np.empty(size, np.int64)
         action = np.empty(size, np.int32)
@@ -418,15 +593,22 @@ class NativeDedupReplay:
         discount = np.empty(size, np.float32)
         alive = np.empty(size, np.uint8)
         mass = np.empty(size, np.float64)
-        self._lib.rc_export(
-            self._handle, _p(frames, _u8p), _p(obs_seq, _i64p),
-            _p(next_seq, _i64p), _p(action, _i32p), _p(reward, _f32p),
-            _p(discount, _f32p), _p(alive, _u8p), _p(mass, _f64p),
-        )
+        if refs is None:
+            self._lib.rc_export(
+                self._handle, frames_p, _p(obs_seq, _i64p),
+                _p(next_seq, _i64p), _p(action, _i32p), _p(reward, _f32p),
+                _p(discount, _f32p), _p(alive, _u8p), _p(mass, _f64p),
+            )
+        else:
+            self._lib.rc_export_rows(
+                self._handle, 0, size, _p(obs_seq, _i64p),
+                _p(next_seq, _i64p), _p(action, _i32p), _p(reward, _f32p),
+                _p(discount, _f32p), _p(alive, _u8p), _p(mass, _f64p),
+            )
         src_ids, src_state = self._resolver.state_arrays()
-        return {
+        out = {
             "dedup": np.asarray(True),
-            "frames": frames, "obs_seq": obs_seq, "next_seq": next_seq,
+            "obs_seq": obs_seq, "next_seq": next_seq,
             "action": action, "reward": reward, "discount": discount,
             "alive": alive.astype(bool),
             "tree_priorities": mass,
@@ -438,6 +620,11 @@ class NativeDedupReplay:
             "frame_capacity": self.frame_capacity,
             "src_ids": src_ids, "src_state": src_state,
         }
+        if refs is None:
+            out["frames"] = frames
+        else:
+            out.update(refs)
+        return out
 
     # -- incremental snapshot (utils/checkpoint_inc delta protocol) -------
     # Dict format is IDENTICAL to DedupReplay's delta — chains written by
@@ -454,7 +641,7 @@ class NativeDedupReplay:
             f_new = fcount - (prev[2] if prev else 0)
             if (force_base or prev is None or n_new >= self.capacity
                     or f_new >= self.frame_capacity):
-                out = self._state_dict_locked()
+                out = self._state_dict_locked(cold_refs=True)
                 out["chain_mark"] = np.asarray([count, fcount], np.int64)
                 self._mark_locked(count, cursor, fcount)
                 return out
@@ -474,6 +661,12 @@ class NativeDedupReplay:
             )
             fspan = (prev_fcount + np.arange(f_new)) % self.frame_capacity
             frames = np.empty((f_new, *self.obs_shape), np.uint8)
+            if self._tier is not None and f_new:
+                # The freshly written span may already have been evicted
+                # (tiny hot budgets) — fault it for the export.
+                self._tier.ensure_hot(self._tier.spans_of_run(
+                    prev_fcount % self.frame_capacity, f_new
+                ))
             self._lib.rc_export_frames_span(
                 self._handle, prev_fcount, f_new, _p(frames, _u8p)
             )
@@ -563,6 +756,10 @@ class NativeDedupReplay:
                 _p(np.ascontiguousarray(delta["span_alive"], np.uint8), _u8p),
                 _p(np.ascontiguousarray(delta["span_tree"], np.float64), _f64p),
             )
+            if self._tier is not None and f_new:
+                self._tier.note_write(
+                    int(prev[1]) % self.frame_capacity, f_new
+                )
             self._lib.rc_import_frames_span(
                 self._handle, int(prev[1]), f_new,
                 _p(np.ascontiguousarray(delta["fspan_frames"], np.uint8), _u8p),
@@ -598,7 +795,34 @@ class NativeDedupReplay:
         if size > self.capacity:
             raise ValueError("snapshot larger than capacity")
         with self._lock:
-            frames = np.ascontiguousarray(state["frames"], np.uint8)
+            nf = min(int(state["fcount"]), self.frame_capacity)
+            tiered_base = "tier_hot_sids" in state
+            adopt = False
+            if tiered_base:
+                from ape_x_dqn_tpu.replay.tiered import read_cold_refs_dense
+
+                span_frames = int(
+                    np.asarray(state["tier_span_frames"]).reshape(-1)[0]
+                )
+                tier_cap = int(
+                    np.asarray(state["tier_capacity"]).reshape(-1)[0]
+                )
+                adopt = (self._tier is not None
+                         and self._tier.span_frames == span_frames
+                         and self._tier.capacity == tier_cap)
+                if adopt:
+                    # O(hot) restore: rows import with an empty frame leg;
+                    # spans land below (hot inline, cold verified+adopted
+                    # in place — the spill file IS the restored data).
+                    frames = np.zeros((0, *self.obs_shape), np.uint8)
+                else:
+                    # Incompatible/no tier: materialize every referenced
+                    # span (CRC- and content-verified) into a dense leg.
+                    frames = np.ascontiguousarray(
+                        read_cold_refs_dense(state)[:nf], np.uint8
+                    )
+            else:
+                frames = np.ascontiguousarray(state["frames"], np.uint8)
             rc = self._lib.rc_import(
                 self._handle, frames.shape[0], _p(frames, _u8p), size,
                 _p(np.ascontiguousarray(state["obs_seq"], np.int64), _i64p),
@@ -626,4 +850,47 @@ class NativeDedupReplay:
             self._resolver.load_state_arrays(
                 state["src_ids"], state["src_state"]
             )
+            if self._tier is not None:
+                self._tier.drop_all()
+                if adopt:
+                    from ape_x_dqn_tpu.replay.tiered import ColdSpanStore
+
+                    tier = self._tier
+                    path = bytes(np.asarray(
+                        state["tier_spill_path"], np.uint8)).decode()
+                    same = (os.path.realpath(path)
+                            == os.path.realpath(tier.store.path))
+                    src = tier.store if same else ColdSpanStore(
+                        path, tier.n_spans, tier.span_bytes
+                    )
+                    try:
+                        hot_sids = np.asarray(
+                            state["tier_hot_sids"], np.int64)
+                        hot_frames = np.asarray(state["tier_hot_frames"])
+                        off = 0
+                        for sid in hot_sids:
+                            n = tier._span_len(int(sid))
+                            tier.install_hot(
+                                int(sid), hot_frames[off:off + n]
+                            )
+                            off += n
+                        for sid, offset, length, crc in zip(
+                            np.asarray(state["tier_cold_sids"], np.int64),
+                            np.asarray(state["tier_cold_offsets"],
+                                       np.int64),
+                            np.asarray(state["tier_cold_lens"], np.int64),
+                            np.asarray(state["tier_cold_crcs"], np.int64),
+                        ):
+                            tier.adopt_cold_ref(
+                                int(sid), int(offset), int(length),
+                                int(crc), src,
+                            )
+                    finally:
+                        if not same:
+                            src.close()
+                elif nf:
+                    # Dense restore into a tiered ring: the whole written
+                    # region just landed hot; the evictor trims it back
+                    # under budget.
+                    self._tier.note_write(0, nf)
             self._ckpt, self._dirty, self._dirty_rows = None, [], 0

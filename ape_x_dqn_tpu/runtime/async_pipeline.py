@@ -459,6 +459,44 @@ class AsyncPipeline:
             help="device idle between fused dispatches (ms)",
             min_s=1e-2, max_s=6e4, per_decade=10,
         )
+        # Host-memory gauge (utils/memory.rss_bytes): the flat-RSS
+        # observable for hours-scale soaks — malloc_trim runs at emit
+        # cadence; this is the number that proves it held.
+        from ape_x_dqn_tpu.utils.memory import rss_bytes
+
+        self.obs_registry.gauge(
+            "host/rss_bytes", help="resident set size of this process"
+        ).set_fn(rss_bytes)
+        # Tiered-replay instruments (replay/tiered.py): live only when the
+        # host replay runs with a hot frame budget.  The named series ride
+        # /varz + /metrics as gauges; the full tier dict (incl. the
+        # fault-latency histogram summary) is the `replay_tier` provider
+        # section and the JSONL emit's `replay_tier` key.
+        self._tier_evictor = None
+        _tier_replay = self.comps.replay
+        if _tier_replay is not None and getattr(_tier_replay, "tier", None) \
+                is not None:
+            from ape_x_dqn_tpu.replay.tiered import TierEvictor
+
+            tier = _tier_replay.tier
+            self.obs_registry.gauge(
+                "replay/spilled_bytes",
+                help="bytes written to the replay cold tier",
+            ).set_fn(lambda: tier.spilled_bytes)
+            self.obs_registry.gauge(
+                "replay/fault_reads",
+                help="cold-span fault reads on the sample path",
+            ).set_fn(lambda: tier.fault_reads)
+            self.obs_registry.gauge(
+                "replay/hot_bytes",
+                help="resident frame bytes in the replay hot tier",
+            ).set_fn(lambda: tier.hot_bytes)
+            self.obs_registry.register_provider(
+                "replay_tier", _tier_replay.tier_stats
+            )
+            # Background evictor: spills ride this thread, never the
+            # learner's critical path (the stager/writer discipline).
+            self._tier_evictor = TierEvictor(_tier_replay)
         self.health = Health(stale_after_s=ocfg.heartbeat_stale_s)
         self._postmortem_dir = self._resolve_postmortem_dir()
         self.recorder = FlightRecorder(
@@ -859,6 +897,12 @@ class AsyncPipeline:
             return self._run_fused(target, warmup_timeout)
         self._obs_run_start(target)
         self.worker.start()
+        if self._tier_evictor is not None:
+            self._tier_evictor.start()
+            self.health.register(
+                "tier_evictor",
+                lambda: time.monotonic() - self._tier_evictor.heartbeat,
+            )
         try:
             self._wait_for_warmup(warmup_timeout)
             with PrefetchQueue(
@@ -919,12 +963,19 @@ class AsyncPipeline:
         finally:
             self.stop_event.set()
             self.worker.join()
+            if self._tier_evictor is not None:
+                self._tier_evictor.stop()
             if self._publisher is not None:
                 self._publisher.close()
             self._close_checkpoints()
             self._close_obs()
         if self.worker.error is not None:
             raise RuntimeError("actor worker died") from self.worker.error
+        if self._tier_evictor is not None \
+                and self._tier_evictor.error is not None:
+            raise RuntimeError(
+                "tier evictor died"
+            ) from self._tier_evictor.error
         # Final emit carries the last step's metrics (one host sync) so the
         # returned record always has learner/loss — callers assert on it.
         return self._emit(metrics, final=True)
@@ -1359,6 +1410,17 @@ class AsyncPipeline:
             "inflight": len(p),
         }}
 
+    def _tier_extra(self) -> dict:
+        """Tiered-replay accounting on the JSONL stream (docs/METRICS.md
+        ``replay_tier`` section): hot/cold occupancy, spill/fault
+        counters, and the fault-latency summary — absent unless the host
+        replay runs with a hot frame budget."""
+        replay = self.comps.replay
+        if replay is None or getattr(replay, "tier", None) is None:
+            return {}
+        stats = replay.tier_stats()
+        return {"replay_tier": stats} if stats else {}
+
     def _ckpt_extra(self) -> dict:
         """Incremental-checkpoint accounting on the JSONL stream: saves /
         bases / deltas / bytes, learner-visible stall, and inflight_skips
@@ -1486,6 +1548,7 @@ class AsyncPipeline:
             stage_us=self.timers.us_per_call(),
             final=final,
             **self._transport_extra(),
+            **self._tier_extra(),
             **self._ckpt_extra(),
             **self._supervisor_extra(),
             **self._obs_extra(),

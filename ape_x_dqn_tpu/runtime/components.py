@@ -189,6 +189,24 @@ def dedup_groups(cfg: ApexConfig) -> int:
     return 1
 
 
+def resolve_spill_dir(cfg: ApexConfig) -> str:
+    """Where the cold tier's spill file lives.  "auto" follows the
+    postmortem-dir policy: a checkpointed run owns its checkpoint dir (and
+    incremental bases reference cold spans by offset into the same tree);
+    an ad-hoc run gets a per-pid tempdir instead of a stray directory."""
+    import os
+    import tempfile
+
+    d = cfg.replay.spill_dir
+    if d != "auto":
+        return d
+    if cfg.learner.checkpoint_every:
+        return os.path.join(cfg.learner.checkpoint_dir, "replay_spill")
+    return os.path.join(
+        tempfile.gettempdir(), f"apex-spill-{os.getpid()}"
+    )
+
+
 def build_components(cfg: ApexConfig) -> Components:
     cfg.validate()
     env_kwargs = dict(
@@ -233,6 +251,18 @@ def build_components(cfg: ApexConfig) -> Components:
         jnp.zeros((1, *obs_shape), jnp.uint8),
         target_dtype=_dtypes[cfg.learner.target_dtype],
     )
+    # Tiered frame store (replay/tiered.py): a positive hot budget caps
+    # the host replay's resident frame bytes; least-recently-sampled spans
+    # spill to the resolved dir and fault back on sample.
+    tier_kwargs = {}
+    if cfg.replay.hot_frame_budget_bytes > 0:
+        tier_kwargs = dict(
+            hot_frame_budget_bytes=cfg.replay.hot_frame_budget_bytes,
+            spill_dir=resolve_spill_dir(cfg),
+            spill_span_frames=cfg.replay.spill_span_frames,
+            spill_watermark_high=cfg.replay.spill_watermark_high,
+            spill_watermark_low=cfg.replay.spill_watermark_low,
+        )
     if cfg.learner.device_replay:
         # Throughput mode keeps the ring in HBM (make_fused_learner); the
         # host replay would be ~capacity × 2 frames of dead host RAM.
@@ -244,12 +274,14 @@ def build_components(cfg: ApexConfig) -> Components:
             cfg.replay.capacity, obs_shape,
             priority_exponent=cfg.replay.priority_exponent,
             frame_ratio=cfg.replay.frame_ratio,
+            **tier_kwargs,
         )
     else:
         replay = PrioritizedReplay(
             cfg.replay.capacity, obs_shape,
             priority_exponent=cfg.replay.priority_exponent,
             frame_compression=cfg.replay.frame_compression,
+            **tier_kwargs,
         )
     learner_step = 0
     restored_path = None

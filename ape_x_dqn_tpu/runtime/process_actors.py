@@ -87,7 +87,9 @@ class SharedParamBuffer:
         self.capacity = int(capacity)
         size = _HEADER.size + self.capacity
         if create:
-            self._shm = shared_memory.SharedMemory(create=True, size=size)
+            from ape_x_dqn_tpu.runtime.shm_ring import create_shared_memory
+
+            self._shm = create_shared_memory("params", size)
             _HEADER.pack_into(self._shm.buf, 0, 0, 0, 0)
         else:
             self._shm = shared_memory.SharedMemory(name=name)

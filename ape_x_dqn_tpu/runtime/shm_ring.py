@@ -57,6 +57,8 @@ space), never lets it overwrite unread bytes.
 from __future__ import annotations
 
 import json
+import os
+import secrets
 import struct
 import time
 import zlib
@@ -64,6 +66,32 @@ from multiprocessing import shared_memory
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
+
+
+def session_shm_name(kind: str) -> str:
+    """A /dev/shm segment name carrying this SESSION's token: ``apx<tok>_
+    <kind>_<pid>_<rand>``.  ``APEX_SHM_SESSION`` is set once per test
+    session / fleet parent and inherited by every child, so tooling (the
+    tests/conftest.py leak guard, obs sweeps) can attribute segments to
+    their session by prefix instead of scanning /dev/shm system-wide —
+    concurrent sessions and unrelated shm users no longer collide."""
+    tok = os.environ.get("APEX_SHM_SESSION", "")
+    return f"apx{tok}_{kind}_{os.getpid()}_{secrets.token_hex(4)}"
+
+
+def create_shared_memory(kind: str, size: int) -> shared_memory.SharedMemory:
+    """SharedMemory(create=True) under a session-prefixed name (collision
+    retried; the random suffix makes one vanishingly rare)."""
+    for _ in range(8):
+        try:
+            return shared_memory.SharedMemory(
+                name=session_shm_name(kind), create=True, size=size
+            )
+        except FileExistsError:
+            continue
+    # Pathological collision storm — fall back to the interpreter's own
+    # psm_ naming rather than fail the fleet spawn.
+    return shared_memory.SharedMemory(create=True, size=size)
 
 _RING_MAGIC = b"APXR"
 _RING_VERSION = 1
@@ -114,8 +142,8 @@ class ShmRing:
         if create:
             if self.capacity < _REC.size + 1:
                 raise ValueError(f"ring capacity {capacity} too small")
-            self._shm = shared_memory.SharedMemory(
-                create=True, size=_HEADER_SIZE + self.capacity
+            self._shm = create_shared_memory(
+                "ring", _HEADER_SIZE + self.capacity
             )
             self._shm.buf[:_HEADER_SIZE] = b"\x00" * _HEADER_SIZE
             _IDENT.pack_into(self._shm.buf, 0, _RING_MAGIC, _RING_VERSION,

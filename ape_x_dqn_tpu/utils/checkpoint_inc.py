@@ -30,6 +30,16 @@ subsystem:
     ``state/`` marker).  A SIGKILL mid-delta-write leaves an uncommitted
     tail file the manifest never references; restore falls back to the
     last manifest.
+  * **Cold-span refs** — a base snapshot of a TIERED replay
+    (replay/tiered.py, ``replay.hot_frame_budget_bytes``) embeds only its
+    hot frames and references every cold span by (offset, length, crc)
+    into the spill file (``tier_cold_*`` arrays in the chunk) instead of
+    paging the cold tier back in: checkpointing a mostly-cold 10M-slot
+    replay costs hot-budget bytes, not ring bytes.  Restore verifies each
+    referenced record's CRC and snapshot-time content CRC; failures are
+    ``ColdSpanCorrupt`` (a ``ChunkCorrupt`` subclass), so the fallback
+    walk below treats a torn cold span exactly like a torn chunk.  The
+    manifest carries ``cold_ref_bytes`` for visibility.
   * **Async writer** — the learner thread only takes the replay's snapshot
     (a bounded memcpy of the dirty span under the replay lock; for device
     rings, slice dispatches — the ``_AsyncPublisher`` latest-wins pattern
@@ -589,6 +599,24 @@ class IncrementalCheckpointer:
                            if mark is not None else None),
             "bytes": nbytes,
         }
+        if "tier_cold_lens" in arrays:
+            # Tiered base: record how much replay data lives ONLY as
+            # cold-span refs (restore needs the spill file for it).
+            hot = arrays.get("tier_hot_frames")
+            frame_bytes = (
+                int(np.prod(hot.shape[1:])) * hot.dtype.itemsize
+                if hot is not None and hot.ndim > 1 else 0
+            )
+            cold_frames = int(np.asarray(arrays["tier_cold_lens"]).sum())
+            manifest["cold_ref_bytes"] = cold_frames * frame_bytes
+            manifest["spill_file"] = bytes(np.asarray(
+                arrays["tier_spill_path"], np.uint8)).decode()
+        elif not is_base and self._manifest is not None \
+                and "cold_ref_bytes" in self._manifest:
+            # Deltas rewrite the manifest — the generation's base still
+            # references its cold spans, so the accounting carries.
+            manifest["cold_ref_bytes"] = self._manifest["cold_ref_bytes"]
+            manifest["spill_file"] = self._manifest.get("spill_file")
         _write_manifest(self._dir, manifest)  # the commit
         self._manifest = manifest
         if is_base:

@@ -39,3 +39,24 @@ def trim_malloc() -> bool:
         return False
     _libc.malloc_trim(0)
     return True
+
+
+_PAGE = None
+
+
+def rss_bytes() -> int:
+    """This process's resident set size in bytes (0 where /proc is
+    unavailable).  Registered as the ``host/rss_bytes`` gauge on the obs
+    registry — the observable that proves the trim discipline above (and
+    the replay cold tier's hot budget) actually hold RSS flat at hours
+    scale; /proc/self/statm field 2 is resident pages."""
+    global _PAGE
+    if _PAGE is None:
+        import os
+
+        _PAGE = os.sysconf("SC_PAGESIZE")
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, ValueError, IndexError):
+        return 0

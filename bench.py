@@ -536,6 +536,152 @@ def _host_dedup_bench(capacity: int = 2_000_000, iters: int = 2000,
     }
 
 
+def _replay_tiered_bench(capacity: int = 200_000, iters: int = 1000,
+                         hot_frac: float = 0.25,
+                         workdir: str | None = None) -> dict:
+    """Tiered replay vs in-core (ROADMAP item 6): a dedup replay whose
+    frame footprint exceeds the hot budget (hot cap <= 25% of frames)
+    sampling/updating at a sustained rate, the background evictor holding
+    the budget while the learner-side loop faults what it samples — the
+    capacity-beyond-DRAM measurement (committed: demos/replay_tiered.json,
+    with the floor arithmetic in demos/README).  Host-only (no jax);
+    native core when the toolchain allows, numpy twin otherwise."""
+    import shutil
+    import tempfile
+
+    from ape_x_dqn_tpu.replay.dedup import DedupReplay
+    from ape_x_dqn_tpu.replay.native_dedup import native_dedup_available
+    from ape_x_dqn_tpu.replay.tiered import TierEvictor
+    from ape_x_dqn_tpu.types import DedupChunk
+
+    if native_dedup_available():
+        from ape_x_dqn_tpu.replay.native_dedup import (
+            NativeDedupReplay as Replay,
+        )
+        core = "native"
+    else:
+        Replay = DedupReplay
+        core = "numpy"
+    rng = np.random.default_rng(0)
+    obs_shape = (84, 84, 1)
+    frame_bytes = int(np.prod(obs_shape))
+    ring_bytes = int(round(capacity * 1.25)) * frame_bytes
+    hot_budget = int(ring_bytes * hot_frac)
+    M = 4096
+    frames = rng.integers(0, 255, (M + 1, *obs_shape), dtype=np.uint8)
+    proto = dict(
+        obs_ref=np.arange(M, dtype=np.int32),
+        next_ref=np.arange(1, M + 1, dtype=np.int32),
+        action=rng.integers(0, 4, M).astype(np.int32),
+        reward=rng.normal(size=M).astype(np.float32),
+        discount=np.full(M, 0.97, np.float32),
+        prev_frames=M + 1,
+    )
+    prio = (np.abs(rng.normal(size=M)) + 0.1).astype(np.float32)
+    n_prefill = max(1, capacity // (2 * M))
+
+    def prefill(rep):
+        for i in range(n_prefill):
+            rep.add(prio, DedupChunk(frames=frames, source=1, chunk_seq=i,
+                                     **proto))
+
+    def run_loop(rep, skew=False):
+        # skew=True restamps with lognormal priorities (heavy-tailed TD
+        # errors — the realistic PER regime): sampling concentrates, the
+        # LRU working set shrinks, fault rate drops.  skew=False is the
+        # near-uniform worst case.
+        if getattr(rep, "tier", None) is not None:
+            # Steady-state methodology: write-back every dirty span's
+            # record (keeping residency), then trim to the budget with
+            # clean drops — the timed region starts with the hot tier AT
+            # its cap and every record current, and measures the steady
+            # sample/fault/clean-drop cycle rather than the one-time
+            # spill of a cold-started ring.
+            rep.tier_flush_dirty()
+            while rep.tier_over_watermark():
+                rep.spill_cold(max_spans=1024)
+        srng = np.random.default_rng(1)
+        urng = np.random.default_rng(2)
+
+        def new_prio():
+            if skew:
+                return np.exp(
+                    2.0 * urng.normal(size=32)
+                ).astype(np.float32)
+            return (np.abs(urng.normal(size=32)) + 0.1).astype(np.float32)
+
+        for _ in range(min(128, iters // 4)):  # warmup (untimed)
+            batch = rep.sample(32, rng=srng)
+            rep.update_priorities(batch.indices, new_prio())
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            batch = rep.sample(32, rng=srng)
+            rep.update_priorities(batch.indices, new_prio())
+        return time.perf_counter() - t0
+
+    # In-core baseline (tier off — the zero-cost-when-off configuration).
+    rep = Replay(capacity, obs_shape, frame_ratio=1.25)
+    prefill(rep)
+    dt_incore = run_loop(rep)
+    del rep
+    # Tiered: hot cap at hot_frac of the ring, background evictor holding
+    # it, the sample loop faulting what it draws.
+    spill = workdir or tempfile.mkdtemp(prefix="apex-bench-tier-")
+    # span_frames=2: obs/next of one transition are adjacent seqs, so a
+    # 2-frame span serves both with minimal read amplification (the auto
+    # 64 KiB spans fault ~4x more bytes per sampled row at this frame
+    # size).
+    rep = Replay(capacity, obs_shape, frame_ratio=1.25,
+                 hot_frame_budget_bytes=hot_budget, spill_dir=spill,
+                 spill_span_frames=2)
+    evictor = TierEvictor(rep, poll_s=0.005)
+    evictor.start()
+    try:
+        prefill(rep)
+        dt_tiered = run_loop(rep)
+        stats = rep.tier_stats()
+        # Second point on the SAME warm replay: heavy-tailed priorities
+        # (the realistic PER regime) — sampling concentrates, faults drop.
+        dt_skew = run_loop(rep, skew=True)
+        stats_skew = rep.tier_stats()
+    finally:
+        evictor.stop()
+        del rep
+        if workdir is None:
+            shutil.rmtree(spill, ignore_errors=True)
+    in_core_rate = iters / dt_incore
+    tiered_rate = iters / dt_tiered
+    skew_rate = iters / dt_skew
+    return {
+        "tiered_pairs_per_sec_skewed": round(skew_rate, 1),
+        "slowdown_x_skewed": round(in_core_rate / max(skew_rate, 1e-9), 2),
+        "fault_reads_skewed_phase": (
+            stats_skew["fault_reads"] - stats["fault_reads"]
+        ),
+        "core": core,
+        "capacity": capacity,
+        "occupancy": min(n_prefill * M, capacity),
+        "ring_gb": round(ring_bytes / 1e9, 3),
+        "hot_budget_gb": round(hot_budget / 1e9, 3),
+        "hot_frac": hot_frac,
+        "in_core_pairs_per_sec": round(in_core_rate, 1),
+        "tiered_pairs_per_sec": round(tiered_rate, 1),
+        "slowdown_x": round(in_core_rate / max(tiered_rate, 1e-9), 2),
+        "spill_writes": stats["spill_writes"],
+        "spilled_gb": round(stats["spilled_bytes"] / 1e9, 3),
+        "fault_reads": stats["fault_reads"],
+        "fault_gb": round(stats["fault_bytes"] / 1e9, 3),
+        "fault_ms": stats["fault_ms"],
+        "hot_bytes_end": stats["hot_bytes"],
+        "note": (
+            "sample(32)+update pairs; tier holds hot <= "
+            f"{int(hot_frac * 100)}% of frames (evictor thread), sample "
+            "path faults cold spans through CRC-verified reads; "
+            "bit-exactness pinned by tests/test_tiered_replay.py"
+        ),
+    }
+
+
 def _checkpoint_stall_bench(capacity: int = 2_000_000,
                             interval_rows: int = 65_536,
                             deltas: int = 3,
@@ -937,6 +1083,17 @@ def main() -> None:
                         help="comma-separated producer counts for "
                         "xp_transport")
     parser.add_argument("--xp-seconds", type=float, default=3.0)
+    parser.add_argument("--skip-replay-tiered", action="store_true",
+                        help="skip the replay_tiered section (disk-spill "
+                        "cold frame store vs in-core)")
+    parser.add_argument("--replay-tiered-capacity", type=int,
+                        default=200_000)
+    parser.add_argument("--replay-tiered-iters", type=int, default=1000)
+    parser.add_argument(
+        "--replay-tiered-only", action="store_true",
+        help="run ONLY the replay_tiered section and print its JSON "
+        "(the demos/replay_tiered.json artifact)",
+    )
     parser.add_argument(
         "--xp-transport-smoke", action="store_true",
         help="CI gate: run ONLY a tiny xp_transport point + barrage "
@@ -945,6 +1102,13 @@ def main() -> None:
         "transport can't reach the driver unseen",
     )
     args = parser.parse_args()
+
+    if args.replay_tiered_only:
+        print(json.dumps({"replay_tiered": _replay_tiered_bench(
+            capacity=args.replay_tiered_capacity,
+            iters=args.replay_tiered_iters,
+        )}))
+        return
 
     if args.ckpt_stall_only:
         print(json.dumps({"checkpoint_stall": _checkpoint_stall_bench(
@@ -1045,6 +1209,13 @@ def main() -> None:
         section("xp_transport", _xp_transport_bench,
                 workers=tuple(int(w) for w in args.xp_workers.split(",")),
                 seconds=args.xp_seconds)
+    if not args.skip_replay_tiered:
+        # Host-only (no jax): the disk-spill cold frame store vs in-core —
+        # sample/update with hot capped at 25% of frames (ROADMAP item 6;
+        # demos/replay_tiered.json is the committed paper-scale point).
+        section("replay_tiered", _replay_tiered_bench,
+                capacity=args.replay_tiered_capacity,
+                iters=args.replay_tiered_iters)
     if not args.skip_ckpt_stall:
         # Host-only: learner-visible checkpoint stall, full-sync vs the
         # incremental async subsystem, at the 2M-slot dedup layout.

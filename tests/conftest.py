@@ -41,11 +41,28 @@ def rng():
 # fds.  This fixture snapshots both at session start and asserts nothing
 # leaked by session end, so any new test that strands a segment or a pipe
 # fails the suite instead of silently eroding the fleet budget.
+#
+# Scoped to THIS session's segments: every segment the repo creates is
+# named through runtime/shm_ring.session_shm_name, which embeds the
+# APEX_SHM_SESSION token pinned below (children inherit it through the
+# environment).  Concurrent pytest sessions or unrelated shm tooling on
+# the same host no longer false-positive the guard — only segments
+# carrying our own token count.
 # ---------------------------------------------------------------------------
+
+import secrets as _secrets
+
+_SHM_TOKEN = _secrets.token_hex(4)
+os.environ["APEX_SHM_SESSION"] = _SHM_TOKEN
+_SHM_PREFIX = f"apx{_SHM_TOKEN}_"
+
 
 def _shm_segments():
     try:
-        return set(os.listdir("/dev/shm"))
+        return {
+            n for n in os.listdir("/dev/shm")
+            if n.startswith(_SHM_PREFIX)
+        }
     except OSError:  # no /dev/shm on this platform — guard is a no-op
         return None
 

@@ -725,10 +725,33 @@ def _flavor(name):
         return (lambda: _make_fused(1)), _fused_feed(1)
     if name == "fused_dp2":
         return (lambda: _make_fused(2)), _fused_feed(2)
+    if name == "tiered_dedup":
+        # Cold-tier dedup (replay/tiered.py): every make() shares ONE
+        # spill dir, so restores exercise the adopt-in-place path and a
+        # corrupt chunk's fallback walk re-verifies cold refs.  A tiny
+        # hot budget keeps most spans cold through the whole matrix.
+        import tempfile
+
+        spill = tempfile.mkdtemp(prefix="apex-tier-flavor-")
+
+        def make_tiered():
+            rep = DedupReplay(64, OBS, frame_ratio=1.25,
+                              hot_frame_budget_bytes=512,
+                              spill_dir=spill, spill_span_frames=4)
+            return rep
+
+        base_feed = _dedup_feed()
+
+        def feed_and_spill(rep, k):
+            base_feed(rep, k)
+            rep.spill_cold()
+
+        return make_tiered, feed_and_spill
     raise ValueError(name)
 
 
-FLAVORS = ["prioritized", "dedup", "native_dedup", "fused_dp1", "fused_dp2"]
+FLAVORS = ["prioritized", "dedup", "native_dedup", "fused_dp1", "fused_dp2",
+           "tiered_dedup"]
 
 
 class TestRestoreUnderCorruption:
@@ -830,6 +853,38 @@ class TestRestoreUnderCorruption:
         with pytest.raises(ChunkCorrupt):
             load_incremental_replay(str(root), make(), fallback=True)
         ci.consume_fallback_events()  # nothing restored; drain any noise
+
+    def test_corrupt_cold_span_record_is_typed_or_fallback(self, tmp_path):
+        """Cold-tier rung of the matrix (ISSUE 7 satellite): a tiered
+        base references spill-file records by offset; scribbling those
+        records must surface as the SAME typed ChunkCorrupt contract as
+        a torn chunk — restore without fallback raises, with fallback it
+        either lands on a rung whose refs still verify (exact state) or
+        raises typed.  Silently-wrong frames are the one forbidden
+        outcome."""
+        make, feed = _flavor("tiered_dedup")
+        root = tmp_path / "cold-span"
+        states, manifest = self._chain(root, make, feed)
+        assert manifest.get("cold_ref_bytes", 0) > 0, (
+            "matrix precondition: the tiered base must reference cold "
+            "spans"
+        )
+        spill_file = manifest["spill_file"]
+        with open(spill_file, "r+b") as f:
+            size = os.fstat(f.fileno()).st_size
+            for off in range(0, size, 128):  # break every record
+                f.seek(off)
+                f.write(b"\xde\xad")
+        with pytest.raises(ChunkCorrupt):
+            load_incremental_replay(str(root), make())
+        rep2 = make()
+        try:
+            step = load_incremental_replay(str(root), rep2, fallback=True)
+        except ChunkCorrupt:
+            ci.consume_fallback_events()
+            return  # typed all the way down — acceptable per contract
+        assert step in states
+        assert_same_state(states[step], rep2.state_dict())
 
     def test_pruning_retains_one_prior_generation(self, tmp_path):
         make, feed = _flavor("prioritized")

@@ -398,6 +398,41 @@ class TestMetricsDocSchema:
         assert "apex_supervisor_respawns_total" \
             in pipe.obs_registry.prometheus_text()
 
+    def test_replay_tier_section_matches_doc(self, tmp_path):
+        """The replay-tier schema rows (ISSUE 7 satellite): the documented
+        key list IS the tier_stats dict that rides the JSONL
+        ``replay_tier`` section and the /varz provider."""
+        import numpy as np
+
+        from ape_x_dqn_tpu.replay.dedup import DedupReplay
+        from ape_x_dqn_tpu.types import DedupChunk
+
+        doc = _doc_keys("## Replay tier schema")
+        assert doc, "Replay tier schema doc section missing"
+        rep = DedupReplay(64, (6, 6, 1), hot_frame_budget_bytes=128,
+                          spill_dir=str(tmp_path), spill_span_frames=4)
+        r = np.random.default_rng(0)
+        rep.add(
+            (np.abs(r.normal(size=8)) + 0.1).astype(np.float32),
+            DedupChunk(
+                frames=r.integers(0, 255, (9, 6, 6, 1), dtype=np.uint8),
+                obs_ref=np.arange(8, dtype=np.int32),
+                next_ref=np.arange(1, 9, dtype=np.int32),
+                action=r.integers(0, 3, 8).astype(np.int32),
+                reward=r.normal(size=8).astype(np.float32),
+                discount=np.full(8, 0.9, np.float32),
+                source=1, chunk_seq=0, prev_frames=9,
+            ),
+        )
+        rep.spill_cold()
+        rep.sample(8, rng=np.random.default_rng(1))  # faults cold spans
+        stats = rep.tier_stats()
+        assert stats["fault_reads"] > 0
+        assert set(doc) == set(stats), set(doc) ^ set(stats)
+        for key in ("count", "mean_ms", "p50_ms", "p95_ms", "p99_ms",
+                    "max_ms"):
+            assert key in stats["fault_ms"], key
+
 
 @pytest.fixture(scope="module")
 def tiny_thread_run():

@@ -1,0 +1,584 @@
+"""Tiered replay (replay/tiered.py): the disk-spill cold frame store.
+
+Contracts pinned here:
+  * ``TieredFrameRing`` is BIT-EXACT with a dense ndarray under any
+    interleaving of puts/gets/spills/faults (zeros for never-written
+    slots included);
+  * eviction is least-recently-sampled first and respects the hot
+    budget; clean re-evictions write nothing;
+  * a torn cold record is DETECTED (typed ``ColdSpanCorrupt``), never
+    returned as frame data — at fault time and at restore time;
+  * tiered DedupReplay / NativeDedupReplay sample, update, snapshot and
+    delta-chain bit-exactly like their dense twins (the tier moves
+    bytes, never the sampling law);
+  * incremental bases reference cold spans by offset (no re-read of the
+    cold tier) and restore O(hot) by adopting the spill file in place —
+    across twins, including dense↔tiered cross-restores;
+  * SIGKILL mid-spill leaves a spill file whose every record is either
+    valid or detectably torn, and the committed chain still restores.
+"""
+
+import multiprocessing
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.replay.dedup import DedupReplay
+from ape_x_dqn_tpu.replay.tiered import (
+    ColdSpanCorrupt,
+    ColdSpanStore,
+    TieredFrameRing,
+    TierEvictor,
+)
+from ape_x_dqn_tpu.types import DedupChunk
+from ape_x_dqn_tpu.utils.checkpoint_inc import (
+    ChunkCorrupt,
+    IncrementalCheckpointer,
+    load_incremental_replay,
+)
+
+OBS = (6, 6, 1)
+
+
+def dchunk(src=1, seq=0, seed=0, M=16, obs=OBS):
+    r = np.random.default_rng(seed * 7919 + src)
+    return DedupChunk(
+        frames=r.integers(0, 255, (M + 1, *obs), dtype=np.uint8),
+        obs_ref=np.arange(M, dtype=np.int32),
+        next_ref=np.arange(1, M + 1, dtype=np.int32),
+        action=r.integers(0, 3, M).astype(np.int32),
+        reward=r.normal(size=M).astype(np.float32),
+        discount=np.full(M, 0.9, np.float32),
+        source=src, chunk_seq=seq, prev_frames=M + 1,
+    )
+
+
+def prio(M=16, seed=0):
+    r = np.random.default_rng(seed + 1000)
+    return (np.abs(r.normal(size=M)) + 0.1).astype(np.float32)
+
+
+def assert_same_state(s1, s2):
+    assert set(s1) == set(s2), (set(s1) ^ set(s2))
+    for k in s1:
+        np.testing.assert_array_equal(
+            np.asarray(s1[k]), np.asarray(s2[k]), err_msg=k
+        )
+
+
+def _native_or_skip():
+    from ape_x_dqn_tpu.replay.native_dedup import (
+        NativeDedupReplay,
+        native_dedup_available,
+        native_dedup_error,
+    )
+
+    if not native_dedup_available():
+        pytest.skip(f"native core unavailable: {native_dedup_error()}")
+    return NativeDedupReplay
+
+
+def make_pair(kind, tmp_path, cap=128, budget=2048, span=4):
+    """(dense twin, tiered twin) of one flavor sharing nothing."""
+    if kind == "dedup":
+        dense = DedupReplay(cap, OBS)
+        tiered = DedupReplay(
+            cap, OBS, hot_frame_budget_bytes=budget,
+            spill_dir=str(tmp_path / "spill"), spill_span_frames=span,
+        )
+    else:
+        cls = _native_or_skip()
+        dense = cls(cap, OBS)
+        tiered = cls(
+            cap, OBS, hot_frame_budget_bytes=budget,
+            spill_dir=str(tmp_path / "spill"), spill_span_frames=span,
+        )
+    return dense, tiered
+
+
+class TestColdSpanStore:
+    def test_roundtrip_and_offset_addressing(self, tmp_path):
+        store = ColdSpanStore(str(tmp_path / "c.cold"), 4, 64)
+        off_a, crc = store.write(2, 0, b"x" * 64)
+        assert store.read(off_a, sid=2, want_crc=crc) == b"x" * 64
+        off_b, crc_b = store.write(2, 1, b"y" * 64)
+        assert off_b == off_a + store.record_size
+        # The A slot survives the B write (the checkpoint-retention
+        # property the A/B discipline exists for).
+        assert store.read(off_a, sid=2, want_crc=crc) == b"x" * 64
+        assert store.read(off_b, sid=2, want_crc=crc_b) == b"y" * 64
+
+    def test_torn_record_is_typed_never_bytes(self, tmp_path):
+        path = str(tmp_path / "c.cold")
+        store = ColdSpanStore(path, 2, 64)
+        off, crc = store.write(1, 0, b"z" * 64)
+        with open(path, "r+b") as f:  # scribble mid-payload
+            f.seek(off + 30)
+            f.write(b"\xff\xfe")
+        with pytest.raises(ColdSpanCorrupt):
+            store.read(off, sid=1, want_crc=crc)
+
+    def test_never_written_slot_is_typed(self, tmp_path):
+        store = ColdSpanStore(str(tmp_path / "c.cold"), 2, 64)
+        with pytest.raises(ColdSpanCorrupt):
+            store.read(store.offset(0, 0), sid=0)
+
+    def test_span_id_mismatch_is_typed(self, tmp_path):
+        store = ColdSpanStore(str(tmp_path / "c.cold"), 4, 64)
+        off, _ = store.write(3, 0, b"q" * 64)
+        with pytest.raises(ColdSpanCorrupt):
+            store.read(off, sid=1)
+
+    def test_content_drift_against_want_crc_is_typed(self, tmp_path):
+        store = ColdSpanStore(str(tmp_path / "c.cold"), 2, 64)
+        off, crc = store.write(0, 0, b"a" * 64)
+        store.write(0, 0, b"b" * 64)  # same slot, new content
+        with pytest.raises(ColdSpanCorrupt):
+            store.read(off, sid=0, want_crc=crc)
+
+    def test_typed_error_is_a_chunk_corrupt(self, tmp_path):
+        # The restore fallback walk catches ChunkCorrupt — cold-span
+        # failures must be that type.
+        assert issubclass(ColdSpanCorrupt, ChunkCorrupt)
+
+    def test_reopen_never_truncates(self, tmp_path):
+        path = str(tmp_path / "c.cold")
+        store = ColdSpanStore(path, 8, 64)
+        off, crc = store.write(7, 1, b"k" * 64)
+        store.close()
+        small = ColdSpanStore(path, 2, 64)  # smaller layout, same file
+        assert small.read(off, sid=7, want_crc=crc) == b"k" * 64
+
+
+class TestTieredFrameRing:
+    def _ring(self, tmp_path, cap=64, budget=0, span=4):
+        return TieredFrameRing(
+            cap, OBS, hot_budget_bytes=budget or 10 ** 9,
+            spill_path=str(tmp_path / "r.cold"), span_frames=span,
+        )
+
+    def test_random_ops_match_dense_oracle(self, tmp_path):
+        rng = np.random.default_rng(0)
+        cap = 64
+        ring = self._ring(tmp_path, cap=cap, budget=1)  # evict-everything
+        oracle = np.zeros((cap, *OBS), np.uint8)
+        for step in range(60):
+            op = rng.integers(0, 3)
+            if op == 0:  # scattered put
+                idx = rng.choice(cap, size=rng.integers(1, 9),
+                                 replace=False)
+                frames = rng.integers(0, 255, (len(idx), *OBS), np.uint8)
+                ring.put(idx, frames)
+                oracle[idx] = frames
+            elif op == 1:  # wrap-aware span put
+                start = int(rng.integers(0, cap))
+                n = int(rng.integers(1, 20))
+                frames = rng.integers(0, 255, (n, *OBS), np.uint8)
+                ring.put_span(start, n, frames)
+                sl = (start + np.arange(n)) % cap
+                oracle[sl] = frames
+            else:
+                ring.spill()  # budget=1 → everything cold
+            idx = rng.choice(cap, size=8, replace=False)
+            np.testing.assert_array_equal(ring.get(idx), oracle[idx])
+            start = int(rng.integers(0, cap))
+            n = int(rng.integers(1, 20))
+            sl = (start + np.arange(n)) % cap
+            np.testing.assert_array_equal(ring.get_span(start, n),
+                                          oracle[sl])
+        assert ring.spill_writes > 0 and ring.fault_reads > 0
+
+    def test_never_written_reads_zeros(self, tmp_path):
+        ring = self._ring(tmp_path)
+        np.testing.assert_array_equal(
+            ring.get(np.asarray([0, 63])), np.zeros((2, *OBS), np.uint8)
+        )
+
+    def test_eviction_is_lru_and_respects_budget(self, tmp_path):
+        ring = TieredFrameRing(
+            64, OBS, hot_budget_bytes=6 * 4 * int(np.prod(OBS)),
+            spill_path=str(tmp_path / "r.cold"), span_frames=4,
+            watermark_low=1.0,
+        )
+        frames = np.arange(64 * np.prod(OBS), dtype=np.uint8).reshape(
+            64, *OBS)
+        ring.put_span(0, 64, frames)          # 16 spans hot
+        ring.get(np.asarray([0]))             # span 0 most-recent
+        spilled, wrote = ring.spill()
+        assert ring.hot_bytes <= ring.hot_budget_bytes
+        assert spilled == 10 and wrote > 0    # 16 → 6 spans
+        assert 0 in ring._hot                 # recently-sampled stayed
+
+    def test_clean_re_eviction_writes_nothing(self, tmp_path):
+        ring = self._ring(tmp_path, budget=1)
+        ring.put_span(0, 8, np.ones((8, *OBS), np.uint8))
+        _, wrote1 = ring.spill()
+        assert wrote1 > 0
+        ring.get(np.asarray([0]))             # fault back, unmodified
+        _, wrote2 = ring.spill()
+        assert wrote2 == 0                    # disk copy still current
+        assert ring.fault_reads == 1
+
+    def test_torn_cold_span_fault_is_typed(self, tmp_path):
+        ring = self._ring(tmp_path, budget=1)
+        ring.put_span(0, 4, np.full((4, *OBS), 7, np.uint8))
+        ring.spill()
+        off = ring.store.offset(0, int(ring._cold_ab[0]))
+        with open(ring.store.path, "r+b") as f:
+            f.seek(off + 20)
+            f.write(b"\x00\x01\x02")
+        with pytest.raises(ColdSpanCorrupt):
+            ring.get(np.asarray([0]))
+
+
+class TestTieredReplayParity:
+    """The tier moves bytes, never the law: tiered twins are bit-exact
+    with dense ones through add / sample / update / snapshot, with
+    evictions forced between every operation."""
+
+    @pytest.mark.parametrize("kind", ["dedup", "native"])
+    def test_sample_update_snapshot_bit_exact(self, tmp_path, kind):
+        dense, tiered = make_pair(kind, tmp_path)
+        rng = np.random.default_rng(1)
+        for k in range(16):  # wraps both rings
+            p, c = prio(seed=k), dchunk(seq=k, seed=k)
+            np.testing.assert_array_equal(dense.add(p, c), tiered.add(p, c))
+            tiered.spill_cold()
+        assert tiered.tier_stats()["spill_writes"] > 0
+        for k in range(12):
+            ra = dense.sample(16, rng=np.random.default_rng(50 + k))
+            rb = tiered.sample(16, rng=np.random.default_rng(50 + k))
+            np.testing.assert_array_equal(ra.indices, rb.indices)
+            np.testing.assert_array_equal(ra.is_weights, rb.is_weights)
+            np.testing.assert_array_equal(ra.transition.obs,
+                                          rb.transition.obs)
+            np.testing.assert_array_equal(ra.transition.next_obs,
+                                          rb.transition.next_obs)
+            up = (np.abs(rng.normal(size=16)) + 0.1).astype(np.float32)
+            dense.update_priorities(ra.indices, up)
+            tiered.update_priorities(rb.indices, up)
+            tiered.spill_cold()
+        assert tiered.tier_stats()["fault_reads"] > 0
+        assert_same_state(dense.state_dict(), tiered.state_dict())
+
+    def test_native_two_phase_equals_fused_call(self, tmp_path):
+        """rc_sample_idx + rc_gather_frames (the tiered path) is
+        bit-identical to the one-call rc_sample given the same uniforms —
+        all-hot, so no faults perturb anything."""
+        cls = _native_or_skip()
+        fused = cls(128, OBS)
+        two = cls(128, OBS, hot_frame_budget_bytes=10 ** 9,
+                  spill_dir=str(tmp_path / "s"), spill_span_frames=4)
+        for k in range(6):
+            p, c = prio(seed=k), dchunk(seq=k, seed=k)
+            fused.add(p, c)
+            two.add(p, c)
+        for k in range(8):
+            u = np.random.default_rng(k).random(16)
+            ra = fused._sample_with_uniforms(u.copy(), 0.4)
+            rb = two._sample_with_uniforms(u.copy(), 0.4)
+            np.testing.assert_array_equal(ra.indices, rb.indices)
+            np.testing.assert_array_equal(ra.is_weights, rb.is_weights)
+            np.testing.assert_array_equal(ra.transition.obs,
+                                          rb.transition.obs)
+        assert two.tier_stats()["fault_reads"] == 0
+
+    def test_tiered_prioritized_replay_parity(self, tmp_path):
+        from ape_x_dqn_tpu.replay.buffer import PrioritizedReplay
+        from ape_x_dqn_tpu.types import NStepTransition
+
+        dense = PrioritizedReplay(64, OBS)
+        tiered = PrioritizedReplay(
+            64, OBS, hot_frame_budget_bytes=4096,
+            spill_dir=str(tmp_path / "p"), spill_span_frames=4,
+        )
+        rng = np.random.default_rng(2)
+        for k in range(8):
+            M = 16
+            t = NStepTransition(
+                obs=rng.integers(0, 255, (M, *OBS), np.uint8),
+                action=rng.integers(0, 3, M).astype(np.int32),
+                reward=rng.normal(size=M).astype(np.float32),
+                discount=np.full(M, 0.9, np.float32),
+                next_obs=rng.integers(0, 255, (M, *OBS), np.uint8),
+            )
+            p = prio(M, seed=k)
+            np.testing.assert_array_equal(dense.add(p, t), tiered.add(p, t))
+            tiered.spill_cold()
+        for k in range(6):
+            ra = dense.sample(8, rng=np.random.default_rng(k))
+            rb = tiered.sample(8, rng=np.random.default_rng(k))
+            np.testing.assert_array_equal(ra.indices, rb.indices)
+            np.testing.assert_array_equal(ra.transition.obs,
+                                          rb.transition.obs)
+            np.testing.assert_array_equal(ra.transition.next_obs,
+                                          rb.transition.next_obs)
+        stats = tiered.tier_stats()
+        assert stats["spill_writes"] > 0 and stats["fault_reads"] > 0
+        assert_same_state(dense.state_dict(), tiered.state_dict())
+
+
+class TestTieredCheckpoint:
+    """Cold-ref bases: bytes ∝ hot budget, O(hot) adopt restore, dense ↔
+    tiered cross-restores bit-exact, torn cold records typed."""
+
+    def _build_chain(self, root, spill, kind, saves=6):
+        if kind == "dedup":
+            rep = DedupReplay(64, OBS, hot_frame_budget_bytes=2048,
+                              spill_dir=spill, spill_span_frames=4)
+        else:
+            cls = _native_or_skip()
+            rep = cls(64, OBS, hot_frame_budget_bytes=2048,
+                      spill_dir=spill, spill_span_frames=4)
+        ck = IncrementalCheckpointer(root, rep, base_every=2, sync=True)
+        for k in range(saves):
+            rep.add(prio(seed=k), dchunk(seq=k, seed=k))
+            rep.spill_cold()
+            b = rep.sample(8, rng=np.random.default_rng(k))
+            rep.update_priorities(b.indices, prio(8, seed=100 + k))
+            rep.spill_cold()
+            ck.save(k + 1)
+        return rep
+
+    @pytest.mark.parametrize("kind", ["dedup", "native"])
+    def test_base_references_cold_spans_and_adopt_restores(
+            self, tmp_path, kind):
+        root, spill = str(tmp_path), str(tmp_path / "spill")
+        rep = self._build_chain(root, spill, kind)
+        want = rep.state_dict()
+        from ape_x_dqn_tpu.utils.checkpoint_inc import (
+            inc_dir,
+            read_chunk,
+            read_manifest,
+        )
+
+        manifest = read_manifest(inc_dir(root))
+        base = read_chunk(os.path.join(inc_dir(root),
+                                       manifest["chunks"][0]))
+        assert "tier_cold_sids" in base, "base must reference cold spans"
+        assert "frames" not in base
+        assert manifest["cold_ref_bytes"] > 0
+        # Adopt restore: same spill dir, fresh replay → zero fault reads.
+        if kind == "dedup":
+            r2 = DedupReplay(64, OBS, hot_frame_budget_bytes=2048,
+                             spill_dir=spill, spill_span_frames=4)
+        else:
+            r2 = _native_or_skip()(64, OBS, hot_frame_budget_bytes=2048,
+                                   spill_dir=spill, spill_span_frames=4)
+        step = load_incremental_replay(root, r2)
+        assert step == manifest["step"]
+        # O(hot) restore: the cold tier is adopted in place, not paged
+        # in.  The only faults allowed are the delta-apply's partially
+        # overwritten boundary spans (bounded by chain length, not by
+        # cold size).
+        stats = r2.tier_stats()
+        assert stats["fault_reads"] <= 2 * (len(manifest["chunks"]) - 1)
+        assert stats["fault_bytes"] < manifest["cold_ref_bytes"]
+        assert_same_state(want, r2.state_dict())
+
+    @pytest.mark.parametrize("kind", ["dedup", "native"])
+    def test_cross_restore_into_dense_twin(self, tmp_path, kind):
+        root, spill = str(tmp_path), str(tmp_path / "spill")
+        rep = self._build_chain(root, spill, kind)
+        want = rep.state_dict()
+        # Tiered chain → the OTHER dense twin (numpy ↔ native stays
+        # interchangeable through the tier).
+        dense = (_native_or_skip()(64, OBS) if kind == "dedup"
+                 else DedupReplay(64, OBS))
+        step = load_incremental_replay(root, dense)
+        assert step == 6
+        assert_same_state(want, dense.state_dict())
+
+    @pytest.mark.parametrize("kind", ["dedup", "native"])
+    def test_heavy_churn_between_saves_keeps_refs_valid(self, tmp_path,
+                                                        kind):
+        """Regression (found driving the real CLI trainer): a small ring
+        wrapping MANY times between saves re-spills every span repeatedly;
+        without the cold_refs pin the A/B slots both get rewritten and the
+        committed base's refs die.  Pinned, the chain restores bit-exactly
+        however hard the ring churns."""
+        root, spill = str(tmp_path), str(tmp_path / "spill")
+        if kind == "dedup":
+            make = lambda: DedupReplay(  # noqa: E731
+                32, OBS, hot_frame_budget_bytes=512,
+                spill_dir=spill, spill_span_frames=4)
+        else:
+            cls = _native_or_skip()
+            make = lambda: cls(  # noqa: E731
+                32, OBS, hot_frame_budget_bytes=512,
+                spill_dir=spill, spill_span_frames=4)
+        rep = make()
+        ck = IncrementalCheckpointer(root, rep, base_every=8, sync=True)
+        seq = 0
+        for save in range(4):
+            for _ in range(6):  # several full ring wraps per interval
+                rep.add(prio(seed=seq), dchunk(seq=seq, seed=seq))
+                rep.spill_cold()
+                rep.sample(8, rng=np.random.default_rng(seq))
+                rep.spill_cold()
+                seq += 1
+            ck.save(save + 1)
+        want = rep.state_dict()
+        r2 = make()
+        assert load_incremental_replay(root, r2) == 4
+        assert_same_state(want, r2.state_dict())
+
+    def test_dense_chain_restores_into_tiered(self, tmp_path):
+        root = str(tmp_path)
+        rep = DedupReplay(64, OBS)
+        ck = IncrementalCheckpointer(root, rep, base_every=2, sync=True)
+        for k in range(5):
+            rep.add(prio(seed=k), dchunk(seq=k, seed=k))
+            ck.save(k + 1)
+        want = rep.state_dict()
+        r2 = DedupReplay(64, OBS, hot_frame_budget_bytes=2048,
+                         spill_dir=str(tmp_path / "spill2"),
+                         spill_span_frames=4)
+        assert load_incremental_replay(root, r2) == 5
+        assert_same_state(want, r2.state_dict())
+
+    @pytest.mark.parametrize("kind", ["dedup", "native"])
+    def test_torn_cold_record_restore_is_fallback_or_typed(
+            self, tmp_path, kind):
+        """The satellite contract: a torn cold span is detected by CRC and
+        restore either walks back to a still-valid rung (exact state) or
+        surfaces the typed error — never silently-wrong frames."""
+        root, spill = str(tmp_path), str(tmp_path / "spill")
+        self._build_chain(root, spill, kind)
+        # Scribble EVERY record header in the spill file — all cold refs
+        # in all generations break.
+        path = os.path.join(spill, "frames.cold")
+        with open(path, "r+b") as f:
+            sz = os.fstat(f.fileno()).st_size
+            for off in range(0, sz, 256):
+                f.seek(off)
+                f.write(b"\xde\xad")
+        fresh = DedupReplay(64, OBS)
+        with pytest.raises(ChunkCorrupt):
+            load_incremental_replay(root, fresh)
+        fresh2 = DedupReplay(64, OBS)
+        try:
+            step = load_incremental_replay(root, fresh2, fallback=True)
+        except ChunkCorrupt:
+            return  # typed all the way down — acceptable per contract
+        assert step is not None  # a rung restored → it was CRC-verified
+
+
+class TestTierEvictor:
+    def test_background_evictor_holds_budget(self, tmp_path):
+        rep = DedupReplay(128, OBS, hot_frame_budget_bytes=4096,
+                          spill_dir=str(tmp_path / "s"),
+                          spill_span_frames=4)
+        ev = TierEvictor(rep, poll_s=0.01)
+        ev.start()
+        try:
+            for k in range(12):
+                rep.add(prio(seed=k), dchunk(seq=k, seed=k))
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if rep.tier.hot_bytes <= 4096:
+                    break
+                time.sleep(0.01)
+            assert rep.tier.hot_bytes <= 4096
+            assert ev.error is None
+        finally:
+            ev.stop()
+        # Samples after background eviction still serve correct frames.
+        dense = DedupReplay(128, OBS)
+        for k in range(12):
+            dense.add(prio(seed=k), dchunk(seq=k, seed=k))
+        ra = dense.sample(8, rng=np.random.default_rng(9))
+        rb = rep.sample(8, rng=np.random.default_rng(9))
+        np.testing.assert_array_equal(ra.transition.obs, rb.transition.obs)
+
+
+def _spill_victim(root: str, mode: str) -> None:
+    """Kill-barrage child: ingest + spill (+ fault, in ``fault`` mode) +
+    sync checkpoint saves as fast as possible until SIGKILLed."""
+    spill = os.path.join(root, "spill")
+    rep = DedupReplay(64, OBS, hot_frame_budget_bytes=1024,
+                      spill_dir=spill, spill_span_frames=4)
+    ck = IncrementalCheckpointer(root, rep, sync=True, base_every=2)
+    step = 0
+    while True:
+        rep.add(prio(seed=step), dchunk(seq=step, seed=step))
+        rep.spill_cold()
+        if mode == "fault":
+            # Read-heavy phase: faults pull spans back, then re-evict.
+            rep.sample(8, rng=np.random.default_rng(step))
+            rep.spill_cold()
+        step += 1
+        ck.save(step)
+
+
+class TestSigkillMidSpillAndFault:
+    @pytest.mark.parametrize("mode", ["spill", "fault"])
+    def test_kill_leaves_detectable_records_and_restorable_chain(
+            self, tmp_path, mode):
+        """SIGKILL a child mid-spill / mid-fault: every record in the
+        spill file must be valid-or-typed (no silent garbage), and the
+        committed manifest must still restore — exactly (the expected
+        state is rebuilt by replaying the deterministic feed) or via a
+        typed/fallback path when the kill tore a referenced record."""
+        from ape_x_dqn_tpu.utils.checkpoint_inc import (
+            inc_dir,
+            read_manifest,
+        )
+
+        ctx = multiprocessing.get_context("fork")
+        rng = np.random.default_rng(0)
+        for round_i in range(2):
+            root = str(tmp_path / f"{mode}-{round_i}")
+            os.makedirs(root, exist_ok=True)
+            proc = ctx.Process(target=_spill_victim, args=(root, mode),
+                               daemon=True)
+            proc.start()
+            try:
+                deadline = time.monotonic() + 60.0
+                while read_manifest(inc_dir(root)) is None:
+                    assert proc.is_alive(), "victim died on its own"
+                    assert time.monotonic() < deadline, "no commit in 60s"
+                    time.sleep(0.01)
+                time.sleep(float(rng.uniform(0.02, 0.2)))
+            finally:
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.join(10.0)
+            # (a) Every A/B record slot: valid or typed, never silent.
+            store = ColdSpanStore(
+                os.path.join(root, "spill", "frames.cold"),
+                n_spans=20, max_payload=4 * int(np.prod(OBS)),
+            )
+            seen = 0
+            for sid in range(20):
+                for ab in (0, 1):
+                    try:
+                        store.read(store.offset(sid, ab), sid=sid)
+                        seen += 1
+                    except ColdSpanCorrupt:
+                        pass
+            store.close()
+            # (b) The committed chain restores (fallback may walk torn
+            # cold refs back; typed if every rung is gone).
+            manifest = read_manifest(inc_dir(root))
+            rep = DedupReplay(64, OBS, hot_frame_budget_bytes=1024,
+                              spill_dir=os.path.join(root, "spill"),
+                              spill_span_frames=4)
+            try:
+                step = load_incremental_replay(root, rep, fallback=True)
+            except ChunkCorrupt:
+                continue  # typed — acceptable; next round
+            assert step is not None and step >= 1
+            # (c) Exact content: replay the deterministic feed to `step`
+            # in a dense twin and compare (ingest-only schedule is
+            # deterministic in both modes — sampling never mutates
+            # frames, and priorities only restamp on update, which the
+            # victim never calls).
+            if mode == "spill":
+                twin = DedupReplay(64, OBS)
+                for k in range(step):
+                    twin.add(prio(seed=k), dchunk(seq=k, seed=k))
+                assert_same_state(twin.state_dict(), rep.state_dict())
+            assert manifest["step"] >= step

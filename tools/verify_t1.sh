@@ -24,7 +24,13 @@
 #         never ingests it), then a committed APXC chunk is bit-flipped
 #         and the resume must walk the chain back (fallback restore) and
 #         train past the restored step — tools/chaos_smoke.py.
-# Gate 7: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
+# Gate 7: tiered-replay spill smoke — a hot-budgeted replay (most spans
+#         cold on disk) must sample bit-exactly against its dense twin
+#         with evictions forced between every op, then survive a SIGKILL
+#         mid-spill: the committed chain restores bit-exactly (cold
+#         spans adopted in place, CRC-verified) and trains past the
+#         restored step — tools/spill_smoke.py.
+# Gate 8: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
 #         command changes, change it HERE too (they must stay
 #         character-identical modulo this wrapper's cd).
 cd "$(dirname "$0")/.." || exit 1
@@ -34,4 +40,5 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/ckpt_smoke.py > /tmp/_t1_ck
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/obs_smoke.py > /tmp/_t1_obs.log 2>&1 || { echo "obs smoke FAILED:"; cat /tmp/_t1_obs.log; exit 1; }
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/pipeline_smoke.py --steps 2048 > /tmp/_t1_pipe.log 2>&1 || { echo "pipeline smoke FAILED:"; cat /tmp/_t1_pipe.log; exit 1; }
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py > /tmp/_t1_chaos.log 2>&1 || { echo "chaos smoke FAILED:"; cat /tmp/_t1_chaos.log; exit 1; }
+timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/spill_smoke.py > /tmp/_t1_spill.log 2>&1 || { echo "spill smoke FAILED:"; cat /tmp/_t1_spill.log; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
