@@ -77,6 +77,31 @@ class ActorConfig:
     # CPU inference (measured on a 1-core VM: nice-0 workers starve the
     # fused learner ~7x below its solo rate).  0 = scheduler default.
     worker_nice: int = 0
+    # Experience-transport backend (mode="process"; runtime/transport.py).
+    # "shm" (default): one SIGKILL-safe shared-memory ring per worker
+    # incarnation — bit-for-bit the pre-refactor path, single-host only.
+    # "tcp" (runtime/net.py): the identical CRC-framed APXT records over
+    # one nonblocking socket per worker (loopback or cross-host), params
+    # fanned out on the same connection as delta-or-full framed messages.
+    transport: str = "shm"
+    # Listener bind address for the tcp backend.  Local fleets keep the
+    # loopback default; a cross-host fleet binds a routable address
+    # (workers dial it back from their hosts).
+    transport_host: str = "127.0.0.1"
+    # Listener port; 0 binds ephemeral (local fleets — the pool exposes
+    # the bound port), a fixed port is for cross-host workers that need a
+    # dialable address known in advance.
+    transport_port: int = 0
+    # Hosts the worker fleet spans (planning arithmetic only — see
+    # transport_budget()'s per_host breakdown; shm bytes never leave the
+    # learner host, socket buffers are counted per host separately).
+    # Must be 1 for the shm backend: /dev/shm cannot cross hosts.
+    transport_hosts: int = 1
+    # Per-connection kernel socket buffer request (tcp backend; SO_SNDBUF
+    # worker-side, SO_RCVBUF learner-side).  This is the tcp twin of
+    # xp_ring_bytes: the bytes a worker can have in flight before its
+    # writes backpressure (full_waits).
+    net_conn_buf_bytes: int = 1 << 20
     # Experience-transport knobs (mode="process"; runtime/shm_ring.py).
     # Each worker incarnation gets one SIGKILL-safe shared-memory ring of
     # xp_ring_bytes: it must hold at least one chunk (a chunk is roughly
@@ -449,6 +474,18 @@ class ApexConfig:
             (a.emission != "strided" or a.flush_every >= a.num_steps,
              "actor.emission=strided requires flush_every >= num_steps"),
             (a.num_workers >= 1, "actor.num_workers must be >= 1"),
+            (a.transport in ("shm", "tcp"),
+             f"unknown actor.transport: {a.transport}"),
+            (0 <= a.transport_port <= 65535,
+             "actor.transport_port must be in [0, 65535]"),
+            (a.transport_hosts >= 1,
+             "actor.transport_hosts must be >= 1"),
+            (a.transport == "tcp" or a.transport_hosts == 1,
+             "actor.transport_hosts > 1 requires actor.transport=tcp "
+             "(shm rings cannot leave the host)"),
+            (a.net_conn_buf_bytes >= 1 << 16,
+             "actor.net_conn_buf_bytes must be >= 64 KiB (one chunk must "
+             "fit the in-flight window)"),
             (0 <= a.worker_nice <= 19,
              "actor.worker_nice must be in [0, 19]"),
             (a.xp_ring_bytes >= 1 << 16,
@@ -697,23 +734,68 @@ def to_dict(cfg: ApexConfig) -> dict:
     return dataclasses.asdict(cfg)
 
 
-def transport_budget(cfg: ApexConfig, num_workers: Optional[int] = None) -> dict:
-    """fd/shm budget of the process-actor experience transport at a given
-    fleet scale — the planning arithmetic for "can this host hold 256
-    workers" (the live twin is ``ProcessActorPool.shm_accounting``).
+def transport_budget(cfg: ApexConfig, num_workers: Optional[int] = None,
+                     hosts: Optional[int] = None) -> dict:
+    """fd/shm/socket budget of the process-actor experience transport at a
+    given fleet scale — the planning arithmetic for "can this host hold
+    256 workers" (the live twin is ``ProcessActorPool.shm_accounting``).
 
-    Per worker the parent holds: one experience-ring shm segment (1 fd for
-    the mapping), the control ``mp.Queue`` (a pipe pair: 2 fds) plus its
-    feeder-thread wakeup fds, and the process sentinel (1 fd) — ~5 fds.
-    The param seqlock buffer is one more shared segment for the fleet.
+    shm backend, per worker the parent holds: one experience-ring shm
+    segment (1 fd for the mapping), the control ``mp.Queue`` (a pipe
+    pair: 2 fds) plus its feeder-thread wakeup fds, and the process
+    sentinel (1 fd) — ~5 fds; the param seqlock buffer is one more
+    shared segment for the fleet.  tcp backend: the ring fd becomes a
+    connection fd, the ring bytes become kernel socket buffers, and the
+    learner host additionally holds one receive buffer per connection
+    plus the listener.
+
+    ``per_host`` breaks the budget down across ``hosts`` (default
+    ``actor.transport_hosts``): **shm bytes stay local-host-only** —
+    rings and the param buffer are learner-host /dev/shm segments and
+    are never charged to remote hosts — while socket buffers are counted
+    separately per host.  Host 0 is the learner's; workers spread evenly
+    (the worker_slice rule).  ``conn_drain_budget_bytes`` is the bounded
+    per-connection share of the poll sweep's byte budget, the number
+    runtime/transport.make_transport hands each NetChannel.
     """
     w = int(num_workers if num_workers is not None else cfg.actor.num_workers)
+    kind = cfg.actor.transport
+    h_n = int(hosts if hosts is not None else cfg.actor.transport_hosts)
+    h_n = max(1, h_n)
     ring = int(cfg.actor.xp_ring_bytes)
+    conn = int(cfg.actor.net_conn_buf_bytes)
+    conn_drain = max(64 << 10, int(cfg.actor.xp_drain_budget_bytes)
+                     // max(1, w))
+    shm = kind == "shm"
+    per_host = []
+    for h in range(h_n):
+        lo = h * w // h_n
+        hi = (h + 1) * w // h_n
+        wh = hi - lo
+        entry = {
+            "host": h,
+            "workers": wh,
+            # Learner-host /dev/shm only: every ring is a segment shared
+            # between the learner and a SAME-HOST worker; remote hosts
+            # hold none (and tcp mode allocates no rings at all).
+            "shm_bytes": (w * ring if (shm and h == 0) else 0),
+            # Kernel socket buffers: each worker's send buffer on its own
+            # host; the learner host adds one receive buffer per
+            # connection in the fleet.
+            "sock_buf_bytes": (
+                0 if shm else wh * conn + (w * conn if h == 0 else 0)
+            ),
+            "conn_drain_budget_bytes": 0 if shm else conn_drain,
+        }
+        per_host.append(entry)
     return {
         "workers": w,
-        "shm_segments": w + 1,               # per-worker ring + param buffer
-        "ring_bytes_each": ring,
-        "ring_bytes_total": w * ring,
-        "fds_per_worker": 5,
-        "est_parent_fds": 5 * w + 8,         # + param shm, logs, slack
+        "transport": kind,
+        "hosts": h_n,
+        "shm_segments": (w + 1) if shm else 0,  # rings + param buffer
+        "ring_bytes_each": ring if shm else 0,
+        "ring_bytes_total": w * ring if shm else 0,
+        "fds_per_worker": 5,                 # ring/conn fd + queue + sentinel
+        "est_parent_fds": 5 * w + 8,         # + param shm / listener, slack
+        "per_host": per_host,
     }

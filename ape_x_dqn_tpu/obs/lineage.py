@@ -61,6 +61,15 @@ class LineageTracker:
         self._completed: deque = deque(maxlen=int(keep_completed))
         self.completed_count = 0
         self.abandoned_count = 0   # slots recycled before the trace closed
+        # Monotone-clock guard (cross-host fleets): a chunk's wire
+        # ``sent_t`` is CLOCK_MONOTONIC on the PRODUCER's host, which is
+        # only comparable here when producer and consumer share a host.
+        # A remote worker's clock can run ahead, making t_act land in our
+        # future and the act→ingest span negative; such stamps are
+        # clamped to ingest time and counted (the
+        # ``lineage/clock_skew_clamped`` observable — a nonzero value
+        # means cross-host spans are skew-bounded, not exact).
+        self.clock_skew_clamped = 0
         self._lock = threading.Lock()
         # True age at sample time, seconds (ms fields in the summary).
         self.age_hist = LatencyHistogram(min_s=1e-3, max_s=7200.0,
@@ -84,6 +93,12 @@ class LineageTracker:
         if idx.size == 0:
             return
         now = time.monotonic()
+        if t_act is not None and t_act > now:
+            # Clock skew (remote producer's monotonic clock runs ahead):
+            # clamp at zero age rather than emit a negative span.
+            t_act = now
+            with self._lock:
+                self.clock_skew_clamped += 1
         with self._lock:
             # Recycled slots first: an overwrite before the old trace
             # completed abandons it (the transition is gone — that IS the
@@ -216,6 +231,7 @@ class LineageTracker:
             "traces_open": open_n,
             "traces_completed": self.completed_count,
             "traces_abandoned": self.abandoned_count,
+            "clock_skew_clamped": self.clock_skew_clamped,
         }
         if include_recent:
             out["recent_spans"] = list(self._completed)

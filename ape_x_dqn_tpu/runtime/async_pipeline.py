@@ -563,6 +563,11 @@ class AsyncPipeline:
             self.obs_registry.register_provider(
                 "xp_transport", pool.transport_stats
             )
+            if pool.transport_kind == "tcp":
+                # Network transport observables (runtime/net.py): bytes/s,
+                # frames, reconnects, torn frames, param fan-out cost —
+                # the `net` section on /varz, /metrics and the JSONL emit.
+                self.obs_registry.register_provider("net", pool.net_stats)
             if self.supervisor is not None:
                 self.supervisor.attach_pool(pool)
         else:
@@ -582,6 +587,13 @@ class AsyncPipeline:
             self.obs_registry.register_provider(
                 "lineage", self._lineage.summary
             )
+            # Cross-host monotone-clock guard: sent_t stamps from a
+            # skewed remote clock are clamped at ingest, never emitted as
+            # negative spans; this counts how often that fired.
+            self.obs_registry.gauge(
+                "lineage/clock_skew_clamped",
+                help="cross-host act timestamps clamped to ingest time",
+            ).set_fn(lambda: self._lineage.clock_skew_clamped)
         # /healthz components (the exporter's liveness view): the learner
         # loop beats inline; the ingest pump already tracks a heartbeat.
         self.health.register(
@@ -1379,13 +1391,19 @@ class AsyncPipeline:
         return out
 
     def _transport_extra(self) -> dict:
-        """Experience-transport metrics (process-actor shm rings): ingest
-        bytes/s, chunk latency, ring-full backpressure, torn-record salvage
-        — absent in thread mode (no cross-process transport)."""
+        """Experience-transport metrics (process-actor mode): ingest
+        bytes/s, chunk latency, backpressure, torn-record salvage —
+        absent in thread mode (no cross-process transport).  On the tcp
+        backend a ``net`` section rides along (docs/METRICS.md): frame/
+        reconnect/torn counters plus param fan-out cost per push."""
         pool = getattr(self.worker, "pool", None)
         if pool is None or not hasattr(pool, "transport_stats"):
             return {}
-        return {"xp_transport": pool.transport_stats()}
+        out = {"xp_transport": pool.transport_stats()}
+        net = pool.net_stats() if hasattr(pool, "net_stats") else {}
+        if net:
+            out["net"] = net
+        return out
 
     def _pipeline_extra(self) -> dict:
         """Overlap accounting on the JSONL stream (docs/METRICS.md

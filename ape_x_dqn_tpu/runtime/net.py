@@ -1,0 +1,968 @@
+"""TCP experience/param transport — the socket twin of the shm ring.
+
+The shm ring (runtime/shm_ring.py) stops at ``/dev/shm``: its SIGKILL-safe
+framing, salvage discipline and drain-budget sweep all assume the learner
+and every worker share one host.  This module carries the SAME CRC-framed
+APXT record stream over a TCP connection, so workers on other hosts (or
+loopback workers proving the path) feed the same replay ingest — the
+learner/actor decoupling IMPALA-style architectures get from a real
+network tier.  Param distribution rides the same connection in reverse:
+the learner fans each ``ParamStore.publish`` version out as a
+delta-or-full framed message, so fan-out cost is measurable per push.
+
+Wire protocol (little-endian, 8-byte-aligned structs):
+
+  * **Hello** (worker → learner, once per connection)::
+
+        4s magic "APXN" | u32 version | i64 worker_id | i64 attempt
+        | i64 token
+
+    ``token`` is the pool's per-run secret — a stale worker from another
+    run (or an earlier incarnation reconnecting after its respawn) is
+    rejected at the handshake, the connection-level twin of the
+    fresh-ring-per-incarnation discipline.
+
+  * **Frames** (both directions after the hello)::
+
+        u32 len | u32 crc | i64 seq | u8 kind | 7x pad   + payload
+
+    ``F_XP`` payloads are byte-identical to one shm-ring record payload
+    (the ``_MSG`` envelope + APXT arrays — ``shm_ring.decode_chunk``
+    decodes either).  The crc mirrors the ring's sampled-window
+    arithmetic (head+tail ``_CRC_WINDOW`` bytes; full under
+    ``crc_full``), and ``seq`` is monotone from 1 per connection per
+    direction.
+
+  * **Torn frames**: a byte stream cannot resync after a corrupt header
+    the way the ring's commit word bounds damage, so ANY framing fault —
+    truncation mid-length-prefix or mid-payload at disconnect, a crc
+    mismatch, a seq skip — is counted as a torn frame, nothing from it is
+    ever delivered, and the recovery unit is the CONNECTION: the writer
+    reconnects with backoff (a fresh seq stream), the reader adopts the
+    new socket.  Exactly the torn-ring-tail contract, at connection
+    granularity.
+
+Deliberately import-light (stdlib only at module scope): worker children
+import it before jax config is pinned, and the bench's producer processes
+load it BY FILE PATH (tools/xp_transport.py) so they never pay the
+package's jax import.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import secrets
+import select
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_NET_MAGIC = b"APXN"
+_NET_VERSION = 1
+_HELLO = struct.Struct("<4sIqqq")     # magic, version, worker_id, attempt, token
+_FRAME = struct.Struct("<IIqB7x")     # len, crc32, seq, kind (24 B, aligned)
+
+F_XP = 1           # worker → learner: one experience record payload
+F_PARAM_FULL = 2   # learner → worker: i64 version | snapshot blob
+F_PARAM_DELTA = 3  # learner → worker: page-delta against the previous version
+
+_CRC_WINDOW = 4096          # shm_ring's sampled-crc coverage, mirrored
+_MAX_FRAME = 1 << 30        # sanity bound on the length prefix
+_RECV_CHUNK = 1 << 18
+_PARAM_PAGE = 64 << 10      # delta granule over the serialized snapshot
+_PFULL = struct.Struct("<q")              # version
+_PDELTA = struct.Struct("<qqIIII")        # version, base, full_crc,
+#                                           page_size, total_pages, changed
+_PIDX = struct.Struct("<I")
+
+_SEND_SLICE = 1 << 18
+
+
+def _as_bytes(part) -> bytes:
+    if isinstance(part, (bytes, bytearray)):
+        return bytes(part)
+    mv = memoryview(part)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    return bytes(mv)
+
+
+def _crc_payload(payload, crc_full: bool = False) -> int:
+    """The ring's sampled head+tail window crc over one joined payload
+    (full when small or ``crc_full`` — see shm_ring's weak-ordering
+    note; over TCP the window still catches in-flight corruption and
+    framing drift, while full crc at chunk rates was the ring's measured
+    whole budget)."""
+    mv = memoryview(payload)
+    n = len(mv)
+    if crc_full or n <= 2 * _CRC_WINDOW:
+        return zlib.crc32(mv)
+    return zlib.crc32(mv[n - _CRC_WINDOW:], zlib.crc32(mv[:_CRC_WINDOW]))
+
+
+def frame_bytes(kind: int, seq: int, parts: Sequence,
+                crc_full: bool = False) -> bytes:
+    """One wire frame: header + payload joined (the socket path pays one
+    gather copy into the kernel regardless — no shm-style zero-copy)."""
+    payload = b"".join(_as_bytes(p) for p in parts)
+    n = len(payload)
+    return _FRAME.pack(n, _crc_payload(payload, crc_full), seq, kind) + payload
+
+
+class FrameParser:
+    """Incremental decoder of one connection's framed byte stream.
+
+    ``feed`` raw recv bytes, ``next`` complete verified frames.  Any
+    framing fault sets ``error`` and the parser yields nothing further —
+    the caller counts a torn frame and retires the connection (the
+    stream-level analogue of a torn ring tail: detected, never
+    delivered).
+    """
+
+    def __init__(self, crc_full: bool = False):
+        self._buf = bytearray()
+        self._crc_full = bool(crc_full)
+        self.seq = 0          # last accepted seq
+        self.frames = 0
+        self.bytes = 0        # raw bytes fed
+        self.error: Optional[str] = None
+
+    def feed(self, data) -> None:
+        self.bytes += len(data)
+        self._buf += data
+
+    def pending(self) -> int:
+        """Buffered bytes not yet a complete frame — nonzero at
+        disconnect means the stream was truncated mid-frame (torn)."""
+        return len(self._buf)
+
+    def next(self) -> Optional[Tuple[int, bytes]]:
+        """(kind, payload) of the next complete frame, else None."""
+        if self.error is not None:
+            return None
+        if len(self._buf) < _FRAME.size:
+            return None
+        length, crc, seq, kind = _FRAME.unpack_from(self._buf, 0)
+        if length > _MAX_FRAME:
+            self.error = "length"
+            return None
+        if len(self._buf) < _FRAME.size + length:
+            return None
+        payload = bytes(self._buf[_FRAME.size:_FRAME.size + length])
+        if seq != self.seq + 1:
+            self.error = "seq"
+            return None
+        if _crc_payload(payload, self._crc_full) != crc:
+            self.error = "crc"
+            return None
+        del self._buf[:_FRAME.size + length]
+        self.seq = seq
+        self.frames += 1
+        return kind, payload
+
+
+class Backoff:
+    """Exponential reconnect backoff with jitter — the in-process twin of
+    the supervisor's RespawnPolicy arithmetic (base doubling per failure,
+    capped, multiplicative jitter so a fleet-wide learner restart does
+    not reconnect in lockstep).  Process-level respawn stays the pool
+    supervisor's job; this only paces one worker's socket retries."""
+
+    def __init__(self, base_s: float = 0.25, max_s: float = 5.0,
+                 jitter: float = 0.25, seed: int = 0):
+        import random
+
+        self._base = float(base_s)
+        self._max = float(max_s)
+        self._jitter = float(jitter)
+        self._rng = random.Random(seed ^ 0xB0FF)
+        self._fails = 0
+        self._next_ok = 0.0
+
+    def ready(self) -> bool:
+        return time.monotonic() >= self._next_ok
+
+    def fail(self) -> None:
+        self._fails += 1
+        delay = min(self._max, self._base * (2 ** (self._fails - 1)))
+        delay *= 1.0 + self._jitter * (2.0 * self._rng.random() - 1.0)
+        self._next_ok = time.monotonic() + delay
+
+    def reset(self) -> None:
+        self._fails = 0
+        self._next_ok = 0.0
+
+
+def build_param_full(version: int, payload: bytes) -> bytes:
+    return _PFULL.pack(int(version)) + payload
+
+
+def build_param_delta(version: int, base_version: int, prev: bytes,
+                      new: bytes, page: int = _PARAM_PAGE) -> Optional[bytes]:
+    """Page-delta between two serialized snapshots, or None when a delta
+    is impossible (size changed) or not worth it (the encoded delta is
+    not meaningfully smaller than the full snapshot — a steady-state
+    training publish touches every page, and then the full frame is the
+    cheaper message)."""
+    if len(prev) != len(new):
+        return None
+    # Small snapshots delta at fine granularity; big ones at the default
+    # page so the per-page compare/index overhead stays negligible.
+    page = min(page, max(256, len(new) // 64))
+    total = (len(new) + page - 1) // page
+    pv, nv = memoryview(prev), memoryview(new)
+    changed: List[int] = []
+    for i in range(total):
+        s = i * page
+        e = min(s + page, len(new))
+        if pv[s:e] != nv[s:e]:
+            changed.append(i)
+    head = _PDELTA.pack(int(version), int(base_version), zlib.crc32(new),
+                        page, total, len(changed))
+    idx = b"".join(_PIDX.pack(i) for i in changed)
+    pages = b"".join(
+        bytes(nv[i * page:min(i * page + page, len(new))]) for i in changed
+    )
+    delta = head + idx + pages
+    if len(delta) > 0.6 * (len(new) + _PFULL.size):
+        return None
+    return delta
+
+
+def apply_param_delta(prev: bytes, payload: bytes) -> Tuple[int, int, bytes]:
+    """(version, base_version, new blob) from one delta frame applied to
+    ``prev``.  Raises ValueError on base mismatch or a crc that does not
+    match the patched blob — the caller's recovery is the connection
+    (drop → reconnect → full snapshot)."""
+    version, base, full_crc, page, total, changed = _PDELTA.unpack_from(
+        payload, 0
+    )
+    off = _PDELTA.size
+    idxs = [
+        _PIDX.unpack_from(payload, off + k * _PIDX.size)[0]
+        for k in range(changed)
+    ]
+    off += changed * _PIDX.size
+    blob = bytearray(prev)
+    if (len(blob) + page - 1) // page != total:
+        raise ValueError("param delta page count mismatch")
+    for i in idxs:
+        s = i * page
+        e = min(s + page, len(blob))
+        blob[s:e] = payload[off:off + (e - s)]
+        off += e - s
+    out = bytes(blob)
+    if zlib.crc32(out) != full_crc:
+        raise ValueError("param delta crc mismatch after patch")
+    return version, base, out
+
+
+# ---------------------------------------------------------------------------
+# Learner side: listener + per-worker channels.
+# ---------------------------------------------------------------------------
+
+
+class NetChannel:
+    """Learner-side endpoint of one worker incarnation's connection — the
+    ring-reader surface ``ProcessActorPool`` sweeps (``read_next`` /
+    ``torn_tail`` / ``committed`` / ``close``), so the pool's poll,
+    salvage, lineage and stats paths are backend-agnostic.
+
+    A channel outlives individual connections: a worker whose socket
+    drops reconnects (fresh hello, same worker_id+attempt) and the
+    channel adopts the new socket, counting the reconnect and treating
+    any half-received frame from the old one as torn.
+    """
+
+    def __init__(self, wid: int, attempt: int, drain_budget: int,
+                 crc_full: bool = False):
+        self.wid = int(wid)
+        self.attempt = int(attempt)
+        self._drain_budget = max(1 << 16, int(drain_budget))
+        self._crc_full = bool(crc_full)
+        self._sock: Optional[socket.socket] = None
+        self._parser = FrameParser(crc_full=crc_full)
+        self._send_lock = threading.Lock()
+        self._out_seq = 0
+        self._ready: List[Tuple[int, bytes]] = []
+        self.records_read = 0
+        self.bytes_read = 0          # delivered frames (header + payload)
+        self.raw_bytes_in = 0        # everything recv'd, incl. torn tails
+        self.reconnects = 0
+        self.torn_frames = 0
+        self.param_sent_version = -1
+        self.param_full_sent = 0
+        self.param_delta_sent = 0
+        self.param_bytes_sent = 0
+        self._ever_connected = False
+        self.full_waits = 0          # backpressure lives worker-side (0)
+
+    # -- connection lifecycle ---------------------------------------------
+
+    def adopt(self, sock: socket.socket) -> None:
+        """Route a freshly-handshaked connection here.  A live previous
+        connection is retired first (its partial frame, if any, counts
+        torn — same as a disconnect)."""
+        with self._send_lock:
+            if self._sock is not None or self._ever_connected:
+                self.reconnects += int(self._ever_connected)
+            self._retire_conn_locked()
+            sock.setblocking(False)
+            self._sock = sock
+            self._parser = FrameParser(crc_full=self._crc_full)
+            self._out_seq = 0
+            self.param_sent_version = -1
+            self._ever_connected = True
+
+    def _retire_conn_locked(self) -> None:
+        # Deliver every frame that already verified BEFORE declaring the
+        # remainder torn — a disconnect must not discard committed
+        # records buffered ahead of the torn tail (the ring's
+        # drain-then-torn salvage order).
+        while True:
+            got = self._parser.next()
+            if got is None:
+                break
+            kind, payload = got
+            if kind != F_XP:
+                self.torn_frames += 1
+                self._parser = FrameParser(crc_full=self._crc_full)
+                break
+            self._ready.append((kind, payload))
+        if self._parser.pending() or self._parser.error is not None:
+            self.torn_frames += 1
+            self._parser = FrameParser(crc_full=self._crc_full)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    # -- reader surface (the ring interface) ------------------------------
+
+    def _pump_recv(self) -> None:
+        sock = self._sock
+        if sock is None:
+            return
+        budget = self._drain_budget
+        while budget > 0:
+            try:
+                data = sock.recv(min(_RECV_CHUNK, budget))
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                with self._send_lock:
+                    self._retire_conn_locked()
+                return
+            if not data:
+                # Orderly close: a truncated frame in the buffer is torn.
+                with self._send_lock:
+                    self._retire_conn_locked()
+                return
+            budget -= len(data)
+            self.raw_bytes_in += len(data)
+            self._parser.feed(data)
+
+    def _drain_parser(self) -> None:
+        while True:
+            got = self._parser.next()
+            if got is None:
+                if self._parser.error is not None:
+                    # Unrecoverable stream: torn, retire the connection —
+                    # the writer's reconnect is the resync point.
+                    with self._send_lock:
+                        self._retire_conn_locked()
+                return
+            kind, payload = got
+            if kind != F_XP:
+                # Protocol violation from a worker (param kinds only flow
+                # learner→worker): treat as stream corruption.
+                self.torn_frames += 1
+                with self._send_lock:
+                    self._retire_conn_locked()
+                return
+            self._ready.append((kind, payload))
+
+    def read_next(self) -> Optional[bytes]:
+        """The next verified experience payload, or None — the exact
+        ShmRing.read_next contract (bounded work per call: one budgeted
+        recv sweep)."""
+        if not self._ready:
+            self._pump_recv()
+            self._drain_parser()
+        if not self._ready:
+            return None
+        _, payload = self._ready.pop(0)
+        self.records_read += 1
+        self.bytes_read += _FRAME.size + len(payload)
+        return payload
+
+    def torn_tail(self) -> bool:
+        """After the writer is gone and the channel drained: did any
+        stream end mid-frame / fail verification?  (Cumulative over the
+        channel's connections — the salvage counter's contract.)"""
+        if self._parser.pending() or self._parser.error is not None:
+            return True
+        return self.torn_frames > 0
+
+    @property
+    def torn_live(self) -> int:
+        """Torn count safe to read on a LIVE channel: a partial frame
+        still arriving on a connected socket is mid-receive, not torn —
+        only a dead connection's leftover (or a parser fault) counts."""
+        return self.torn_frames + int(
+            self._parser.error is not None
+            or (self._parser.pending() > 0 and not self.connected)
+        )
+
+    @property
+    def started(self) -> int:
+        return self.records_read + len(self._ready) + (
+            1 if (self._parser.pending() or self._parser.error) else 0
+        )
+
+    @property
+    def committed(self) -> int:
+        return self.records_read + len(self._ready)
+
+    @property
+    def committed_bytes(self) -> int:
+        return self.raw_bytes_in
+
+    # -- param push (learner → worker) ------------------------------------
+
+    def send_frame(self, kind: int, payload: bytes,
+                   timeout: float = 2.0) -> bool:
+        """Bounded send of one learner→worker frame.  On timeout or error
+        the connection is dropped (a slow/stuck subscriber must not stall
+        the publish fan-out; the worker reconnects and gets a full
+        snapshot) — False is returned either way."""
+        with self._send_lock:
+            sock = self._sock
+            if sock is None:
+                return False
+            buf = memoryview(frame_bytes(kind, self._out_seq + 1, [payload],
+                                         self._crc_full))
+            deadline = time.monotonic() + timeout
+            off = 0
+            while off < len(buf):
+                try:
+                    off += sock.send(buf[off:off + _SEND_SLICE])
+                except (BlockingIOError, InterruptedError):
+                    if time.monotonic() > deadline:
+                        self._retire_conn_locked()
+                        return False
+                    select.select([], [sock], [], 0.05)
+                except OSError:
+                    self._retire_conn_locked()
+                    return False
+            self._out_seq += 1
+            return True
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        # Settle accounting BEFORE dropping the socket: bytes the kernel
+        # already buffered may still complete frames (they are simply
+        # discarded unread — close is teardown, not salvage; salvage
+        # drains via read_next first).
+        self._pump_recv()
+        self._drain_parser()
+        with self._send_lock:
+            self._retire_conn_locked()
+
+    def unlink(self) -> None:  # shm-interface parity: nothing on disk
+        pass
+
+
+class NetTransport:
+    """Learner-side TCP transport: one nonblocking listener, one
+    ``NetChannel`` per live worker incarnation, and the param fan-out.
+
+    ``pump()`` (called from the pool's poll sweep) accepts pending
+    connections, completes hellos, routes each to its channel — rejecting
+    stale tokens/attempts — and pushes the current param snapshot to
+    fresh connections.  ``set_params`` fans a new version out to every
+    connected worker as delta-or-full frames, recording the cost per
+    push.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 drain_budget_per_conn: int = 1 << 20,
+                 conn_buf_bytes: int = 1 << 20, crc_full: bool = False,
+                 hello_timeout_s: float = 5.0):
+        self.host = host
+        self._conn_buf = int(conn_buf_bytes)
+        self._drain_budget = int(drain_budget_per_conn)
+        self._crc_full = bool(crc_full)
+        self._hello_timeout = float(hello_timeout_s)
+        self.token = secrets.randbits(63) or 1
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, int(port)))
+        self._lsock.listen(512)
+        self._lsock.setblocking(False)
+        self.port = self._lsock.getsockname()[1]
+        self._lock = threading.RLock()
+        self._channels: Dict[int, NetChannel] = {}
+        self._pending: List[list] = []   # [sock, bytearray, deadline]
+        self.rejects = 0
+        self.param_pushes = 0
+        self.param_bytes = 0
+        self.param_full = 0
+        self.param_delta = 0
+        self.param_drops = 0
+        self.param_fanout_ms_total = 0.0
+        self.param_last_push: Optional[dict] = None
+        self._param_payload: Optional[bytes] = None
+        self._param_version = 0
+        self._param_prev: Optional[bytes] = None
+        self._param_prev_version = -1
+        self._rate_t = time.monotonic()
+        self._rate_bytes = 0
+        # Retired-channel accumulators: a respawned worker's old channel
+        # (or the whole fleet at stop) must not take its traffic history
+        # with it — stats() reports base + live sums, the pool's
+        # _full_waits_base discipline.
+        self._base = {"bytes_in": 0, "frames_in": 0, "torn_frames": 0,
+                      "reconnects": 0}
+        self._closed = False
+
+    # -- channel registry --------------------------------------------------
+
+    def make_channel(self, wid: int, attempt: int) -> NetChannel:
+        """A fresh channel for one worker incarnation (the per-incarnation
+        ring's twin — the pool replaces it on respawn, so a zombie
+        previous incarnation can never write into the new stream)."""
+        ch = NetChannel(wid, attempt, self._drain_budget,
+                        crc_full=self._crc_full)
+        with self._lock:
+            self._channels[wid] = ch
+        return ch
+
+    def _fold_retired_locked(self, ch: NetChannel) -> None:
+        self._base["bytes_in"] += ch.raw_bytes_in
+        self._base["frames_in"] += ch.records_read + len(ch._ready)
+        self._base["torn_frames"] += ch.torn_live
+        self._base["reconnects"] += ch.reconnects
+
+    def drop_channel(self, wid: int, channel: NetChannel) -> None:
+        with self._lock:
+            if self._channels.get(wid) is channel:
+                del self._channels[wid]
+                self._fold_retired_locked(channel)
+
+    # -- accept/handshake pump ---------------------------------------------
+
+    def pump(self) -> None:
+        if self._closed:
+            return
+        while True:
+            try:
+                sock, _addr = self._lsock.accept()
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                break
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                                self._conn_buf)
+            except OSError:
+                pass
+            self._pending.append(
+                [sock, bytearray(), time.monotonic() + self._hello_timeout]
+            )
+        still = []
+        for ent in self._pending:
+            sock, buf, deadline = ent
+            try:
+                while len(buf) < _HELLO.size:
+                    data = sock.recv(_HELLO.size - len(buf))
+                    if not data:
+                        raise OSError("eof before hello")
+                    buf += data
+            except (BlockingIOError, InterruptedError):
+                if time.monotonic() > deadline:
+                    self.rejects += 1
+                    sock.close()
+                else:
+                    still.append(ent)
+                continue
+            except OSError:
+                self.rejects += 1
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            self._route(sock, bytes(buf))
+        self._pending = still
+
+    def _route(self, sock: socket.socket, hello: bytes) -> None:
+        try:
+            magic, version, wid, attempt, token = _HELLO.unpack(hello)
+        except struct.error:
+            magic = b""
+            version = wid = attempt = token = -1
+        with self._lock:
+            ch = self._channels.get(wid)
+            ok = (
+                magic == _NET_MAGIC and version == _NET_VERSION
+                and token == self.token and ch is not None
+                and ch.attempt == attempt
+            )
+            if not ok:
+                self.rejects += 1
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            ch.adopt(sock)
+            payload, pversion = self._param_payload, self._param_version
+        # Fresh connection: the current snapshot rides down immediately
+        # (full — the worker has no baseline), so a worker that connects
+        # after the first publish still syncs without waiting a cadence.
+        if payload is not None:
+            if ch.send_frame(F_PARAM_FULL,
+                             build_param_full(pversion, payload)):
+                ch.param_sent_version = pversion
+                ch.param_full_sent += 1
+                ch.param_bytes_sent += len(payload)
+                self.param_full += 1
+                self.param_bytes += len(payload)
+            else:
+                self.param_drops += 1
+
+    # -- param fan-out ------------------------------------------------------
+
+    def set_params(self, payload: bytes, version: int) -> dict:
+        """Fan one published version out to every connected worker —
+        delta against the previous push where the worker holds it, full
+        otherwise.  Returns the per-push cost record (also kept as
+        ``param_last_push`` for the stats surface)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            prev, prev_v = self._param_payload, self._param_version
+            self._param_prev, self._param_prev_version = prev, prev_v
+            self._param_payload, self._param_version = payload, int(version)
+            channels = list(self._channels.values())
+        delta = None
+        if prev is not None:
+            delta = build_param_delta(version, prev_v, prev, payload)
+        sent_full = sent_delta = sent_bytes = drops = 0
+        for ch in channels:
+            if not ch.connected:
+                continue
+            if delta is not None and ch.param_sent_version == prev_v:
+                if ch.send_frame(F_PARAM_DELTA, delta):
+                    ch.param_sent_version = int(version)
+                    ch.param_delta_sent += 1
+                    ch.param_bytes_sent += len(delta)
+                    sent_delta += 1
+                    sent_bytes += len(delta)
+                else:
+                    drops += 1
+                continue
+            full = build_param_full(version, payload)
+            if ch.send_frame(F_PARAM_FULL, full):
+                ch.param_sent_version = int(version)
+                ch.param_full_sent += 1
+                ch.param_bytes_sent += len(full)
+                sent_full += 1
+                sent_bytes += len(full)
+            else:
+                drops += 1
+        ms = (time.perf_counter() - t0) * 1e3
+        self.param_pushes += 1
+        self.param_full += sent_full
+        self.param_delta += sent_delta
+        self.param_bytes += sent_bytes
+        self.param_drops += drops
+        self.param_fanout_ms_total += ms
+        push = {
+            "version": int(version),
+            "subscribers": sent_full + sent_delta,
+            "full": sent_full,
+            "delta": sent_delta,
+            "bytes": sent_bytes,
+            "delta_bytes": len(delta) if delta is not None else None,
+            "fanout_ms": round(ms, 3),
+            "drops": drops,
+        }
+        self.param_last_push = push
+        return push
+
+    # -- stats --------------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The obs ``net`` section (docs/METRICS.md "Net transport
+        schema" — key set pinned by tests/test_obs.py)."""
+        with self._lock:
+            channels = list(self._channels.values())
+            base = dict(self._base)
+        bytes_in = base["bytes_in"] + sum(c.raw_bytes_in for c in channels)
+        now = time.monotonic()
+        dt = max(1e-3, now - self._rate_t)
+        rate = max(0.0, bytes_in - self._rate_bytes) / dt
+        if dt >= 0.2:
+            self._rate_t, self._rate_bytes = now, bytes_in
+        return {
+            "connections": sum(1 for c in channels if c.connected),
+            "expected": len(channels),
+            "bytes_in": bytes_in,
+            "bytes_in_per_s": round(rate, 1),
+            "frames_in": base["frames_in"] + sum(
+                c.records_read + len(c._ready) for c in channels
+            ),
+            "torn_frames": base["torn_frames"] + sum(
+                c.torn_live for c in channels
+            ),
+            "reconnects": base["reconnects"] + sum(
+                c.reconnects for c in channels
+            ),
+            "rejects": self.rejects,
+            "param_pushes": self.param_pushes,
+            "param_full": self.param_full,
+            "param_delta": self.param_delta,
+            "param_bytes": self.param_bytes,
+            "param_drops": self.param_drops,
+            "param_fanout_ms_last": (
+                self.param_last_push["fanout_ms"]
+                if self.param_last_push else None
+            ),
+            "param_fanout_ms_mean": round(
+                self.param_fanout_ms_total / max(1, self.param_pushes), 3
+            ),
+            "param_last_push": self.param_last_push,
+        }
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        for ent in self._pending:
+            try:
+                ent[0].close()
+            except OSError:
+                pass
+        self._pending = []
+        with self._lock:
+            for ch in self._channels.values():
+                try:
+                    ch.close()
+                except OSError:
+                    pass
+                self._fold_retired_locked(ch)
+            self._channels.clear()
+
+
+# ---------------------------------------------------------------------------
+# Worker side.
+# ---------------------------------------------------------------------------
+
+
+class NetWriter:
+    """Worker-side end of the transport: the ShmRing-writer surface
+    (``write(parts, should_stop, ...)``) over a TCP connection, plus the
+    param subscription riding the same socket in reverse.
+
+    Backpressure comes from the kernel send buffer instead of ring
+    occupancy — a blocked send counts ``full_waits`` exactly like a
+    ring-full sleep.  On any socket error the writer reconnects with
+    jittered exponential backoff (``Backoff``) and re-sends the frame in
+    flight whole.  Delivery contract at a connection loss: the ONE frame
+    in flight may be duplicated (send errored, re-sent whole — a
+    duplicate experience chunk is harmless to replay) or lost (the
+    kernel accepted it before the peer's reset — experience streams are
+    loss-tolerant by design; the pool's respawn/salvage discipline is
+    what bounds it); every other frame is exactly-once, and the
+    per-connection seq stream guarantees no SILENT gaps within a
+    connection.
+    """
+
+    def __init__(self, spec: dict, crc_full: bool = False):
+        self.host = spec["host"]
+        self.port = int(spec["port"])
+        self.wid = int(spec["wid"])
+        self.attempt = int(spec["attempt"])
+        self.token = int(spec["token"])
+        self._conn_buf = int(spec.get("conn_buf", 1 << 20))
+        self._crc_full = bool(crc_full)
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        self._parser = FrameParser(crc_full=crc_full)
+        self._backoff = Backoff(seed=(self.wid << 8) ^ self.attempt)
+        self.full_waits = 0
+        self.reconnects = 0
+        self.records_written = 0
+        self.bytes_written = 0
+        self.param_crc_errors = 0
+        self._param_payload: Optional[bytes] = None
+        self._param_version = -1
+        self._ever_connected = False
+
+    # -- connection management ---------------------------------------------
+
+    def _drop_conn(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def ensure_connected(self) -> bool:
+        """One bounded connect attempt when the backoff window allows —
+        callers poll (the write loop, pump_params) rather than block."""
+        if self._sock is not None:
+            return True
+        if not self._backoff.ready():
+            return False
+        try:
+            sock = socket.create_connection((self.host, self.port),
+                                            timeout=2.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                                self._conn_buf)
+            except OSError:
+                pass
+            sock.sendall(_HELLO.pack(_NET_MAGIC, _NET_VERSION, self.wid,
+                                     self.attempt, self.token))
+            sock.setblocking(False)
+        except OSError:
+            self._backoff.fail()
+            return False
+        self._sock = sock
+        self._seq = 0
+        self._parser = FrameParser(crc_full=self._crc_full)
+        self._backoff.reset()
+        self.reconnects += int(self._ever_connected)
+        self._ever_connected = True
+        return True
+
+    # -- experience writes (the ring-writer surface) -----------------------
+
+    def write(self, parts: Sequence, should_stop: Optional[Callable] = None,
+              sleep_s: float = 0.001, timeout: Optional[float] = None) -> bool:
+        """Blocking send of one experience record with backpressure and
+        reconnect; aborts (False) on ``should_stop`` or ``timeout`` —
+        the exact ShmRing.write contract."""
+        payload = b"".join(_as_bytes(p) for p in parts)
+        deadline = time.monotonic() + timeout if timeout else None
+        buf: Optional[memoryview] = None
+        off = 0
+        while True:
+            if should_stop is not None and should_stop():
+                return False
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            if self._sock is None:
+                buf = None
+                if not self.ensure_connected():
+                    time.sleep(sleep_s)
+                    continue
+            if buf is None:
+                buf = memoryview(
+                    _FRAME.pack(len(payload),
+                                _crc_payload(payload, self._crc_full),
+                                self._seq + 1, F_XP) + payload
+                )
+                off = 0
+            try:
+                off += self._sock.send(buf[off:off + _SEND_SLICE])
+            except (BlockingIOError, InterruptedError):
+                # Kernel buffer full: the socket twin of a ring-full sleep.
+                self.full_waits += 1
+                self.pump_params()
+                select.select([], [self._sock], [], sleep_s)
+                continue
+            except OSError:
+                self._drop_conn()
+                self._backoff.fail()
+                continue
+            if off >= len(buf):
+                self._seq += 1
+                self.records_written += 1
+                self.bytes_written += len(buf)
+                self.pump_params()
+                return True
+
+    # -- param subscription -------------------------------------------------
+
+    def pump_params(self) -> None:
+        """Drain learner→worker frames (nonblocking).  A delta that fails
+        to apply — wrong base, crc mismatch after patch — drops the
+        connection: the reconnect's full snapshot is the recovery, and
+        the stale params stay served meanwhile (never torn ones)."""
+        if self._sock is None:
+            self.ensure_connected()
+            if self._sock is None:
+                return
+        while True:
+            try:
+                data = self._sock.recv(_RECV_CHUNK)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._drop_conn()
+                self._backoff.fail()
+                return
+            if not data:
+                self._drop_conn()
+                self._backoff.fail()
+                return
+            self._parser.feed(data)
+        while True:
+            got = self._parser.next()
+            if got is None:
+                if self._parser.error is not None:
+                    self._drop_conn()
+                    self._backoff.fail()
+                return
+            kind, payload = got
+            try:
+                if kind == F_PARAM_FULL:
+                    (version,) = _PFULL.unpack_from(payload, 0)
+                    self._param_payload = payload[_PFULL.size:]
+                    self._param_version = int(version)
+                elif kind == F_PARAM_DELTA:
+                    if self._param_payload is None:
+                        raise ValueError("delta with no baseline")
+                    version, base, blob = apply_param_delta(
+                        self._param_payload, payload
+                    )
+                    if base != self._param_version:
+                        raise ValueError("delta base version mismatch")
+                    self._param_payload = blob
+                    self._param_version = int(version)
+                # Unknown kinds: ignored (forward compatibility).
+            except ValueError:
+                self.param_crc_errors += 1
+                self._drop_conn()
+                self._backoff.fail()
+                return
+
+    def latest_params(self) -> Optional[Tuple[bytes, int]]:
+        if self._param_payload is None:
+            return None
+        return self._param_payload, self._param_version
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        self._drop_conn()

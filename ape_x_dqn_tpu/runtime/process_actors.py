@@ -15,16 +15,22 @@ module is that mode, on the TPU-native transport stack:
     live objects, readers never block the writer.  The same snapshot bytes
     are what a DCN fetch would ship between hosts — the store is the seam
     (runtime/param_store.py).
-  * **Experience transport** — one SIGKILL-safe single-producer/single-
-    consumer shared-memory ring per worker incarnation
+  * **Experience transport** — pluggable behind ``runtime/transport.py``
+    (``actor.transport``).  Default: one SIGKILL-safe single-producer/
+    single-consumer shared-memory ring per worker incarnation
     (``runtime/shm_ring.ShmRing``): workers gather chunks into the ring in
     the ``utils/serialization`` APXT wire format (numpy frame bytes written
     once, no pickle), the learner drains every ring in one batched sweep
     per poll and hands whole chunks to replay ingest as zero-copy views.
     A worker killed mid-record leaves a detectably torn tail instead of a
     held lock — the salvage-and-respawn discipline ``mp.Queue`` could only
-    approximate by abandoning a whole queue.  ``mp.Queue`` remains as a
-    low-volume CONTROL channel (done/error/episode stats only).
+    approximate by abandoning a whole queue.  The ``tcp`` backend
+    (``runtime/net.py``) carries the identical CRC-framed records over a
+    socket per worker — loopback or cross-host — with params fanned out
+    on the same connection as delta-or-full framed messages; the pool's
+    poll/salvage/stats paths are identical either way.  ``mp.Queue``
+    remains as a low-volume CONTROL channel (done/error/episode stats
+    only).
   * **Worker processes** are CPU-only JAX (pinned via ``jax.config`` — the
     env var is not sufficient on plugin-pinning images — before
     the child imports jax): exactly one process — the learner — owns the
@@ -58,6 +64,12 @@ from ape_x_dqn_tpu.runtime.shm_ring import (
     ShmRing,
     decode_chunk,
     encode_chunk_parts,
+)
+from ape_x_dqn_tpu.runtime.transport import (
+    NetParamSource,
+    NetParamStore,
+    connect_channel,
+    make_transport,
 )
 
 _HEADER = struct.Struct("<qqI")  # (seqlock version, payload length, crc32)
@@ -284,16 +296,18 @@ def network_and_template(cfg):
 
 
 def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
-                 shm_name: str, shm_capacity: int, ring_name: str,
-                 ring_capacity: int, ctl_queue, stop_evt,
+                 param_spec: dict, xp_spec: dict, ctl_queue, stop_evt,
                  steps_budget: int, quantum: int, attempt: int = 0,
                  seed_base: int = 0, nice: int = 0,
                  stats_name: Optional[str] = None):
     """Worker process entry: CPU-only jax, one ActorFleet slice, gather
-    chunks into this incarnation's shm ring; episode stats / completion /
-    errors ride the low-volume control queue.  Metrics ride the
-    incarnation's shm stats block (obs/shm_stats): slots + flight-recorder
-    events the parent can read even after a SIGKILL."""
+    chunks into this incarnation's transport channel (shm ring or TCP
+    connection — ``xp_spec`` names the backend); episode stats /
+    completion / errors ride the low-volume control queue.  Params arrive
+    per ``param_spec``: the shared seqlock buffer (shm) or delta/full
+    frames on the experience connection (tcp).  Metrics ride the
+    incarnation's shm stats block (obs/shm_stats): slots +
+    flight-recorder events the parent can read even after a SIGKILL."""
     if nice:
         # QoS: on hosts where workers share cores with the learner, a
         # positive niceness keeps the learner's dispatch thread scheduled
@@ -375,9 +389,14 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
             emit_dedup=cfg.replay.dedup,
             emit_dedup_groups=_dedup_groups(cfg),
         )
-        buf = SharedParamBuffer(shm_capacity, name=shm_name, create=False)
-        ring = ShmRing(ring_capacity, name=ring_name, create=False)
-        source = SharedBufferParamSource(buf, template)
+        ring = connect_channel(xp_spec)
+        if param_spec["kind"] == "shm":
+            buf = SharedParamBuffer(param_spec["capacity"],
+                                    name=param_spec["name"], create=False)
+            source = SharedBufferParamSource(buf, template)
+        else:
+            # tcp: params ride the experience connection in reverse.
+            source = NetParamSource(ring, template)
         # Observability: the incarnation's shm stats block (parent-created;
         # this worker is the single writer) + a flight recorder mirrored
         # into its event ring.  Metrics must never kill a worker — any
@@ -548,15 +567,36 @@ class ProcessActorPool:
         self._NStepTransition = NStepTransition
         self.cfg = cfg
         self.num_workers = int(num_workers)
-        if shm_capacity is None:
-            # Size from the actual serialized template + headroom.
-            from ape_x_dqn_tpu.utils.serialization import tree_to_bytes
+        self._queue_size = int(queue_size)
+        self._ring_bytes = int(
+            ring_bytes if ring_bytes is not None else cfg.actor.xp_ring_bytes
+        )
+        self._drain_budget = int(
+            drain_budget_bytes if drain_budget_bytes is not None
+            else cfg.actor.xp_drain_budget_bytes
+        )
+        # Experience transport backend (runtime/transport.py): the shm
+        # ring by default — bit-for-bit the pre-seam path — or TCP
+        # channels carrying the identical framed records.  Param
+        # distribution follows the backend: the shared seqlock buffer
+        # (shm) or delta/full frames on the experience connections (tcp,
+        # NetParamStore).
+        self._transport = make_transport(
+            cfg, self.num_workers, self._ring_bytes, self._drain_budget
+        )
+        if self._transport.kind == "tcp":
+            self.buffer = None
+            self.store = NetParamStore(self._transport)
+        else:
+            if shm_capacity is None:
+                # Size from the actual serialized template + headroom.
+                from ape_x_dqn_tpu.utils.serialization import tree_to_bytes
 
-            _, _, template = network_and_template(cfg)
-            shm_capacity = len(tree_to_bytes(jax.device_get(template)))
-            shm_capacity += shm_capacity // 4 + 4096
-        self.buffer = SharedParamBuffer(shm_capacity)
-        self.store = SharedMemoryParamStore(self.buffer)
+                _, _, template = network_and_template(cfg)
+                shm_capacity = len(tree_to_bytes(jax.device_get(template)))
+                shm_capacity += shm_capacity // 4 + 4096
+            self.buffer = SharedParamBuffer(shm_capacity)
+            self.store = SharedMemoryParamStore(self.buffer)
         self._ctx = mp.get_context("spawn")
         # Experience rides one shm ring PER WORKER INCARNATION (replaced on
         # respawn): the ring is SIGKILL-safe by construction — no locks, a
@@ -567,16 +607,8 @@ class ProcessActorPool:
         # stats): low-volume, and its round-5 SIGKILL hazard (a worker
         # killed mid-put strands the queue's shared write lock) is confined
         # by the same per-incarnation replacement discipline.
-        self._queue_size = int(queue_size)
         self._queues: dict = {}
-        self._rings: dict = {}
-        self._ring_bytes = int(
-            ring_bytes if ring_bytes is not None else cfg.actor.xp_ring_bytes
-        )
-        self._drain_budget = int(
-            drain_budget_bytes if drain_budget_bytes is not None
-            else cfg.actor.xp_drain_budget_bytes
-        )
+        self._rings: dict = {}  # wid -> channel (ShmRing | NetChannel)
         self.transport = TransportStats()
         self._full_waits_base = 0  # full_waits of retired incarnations
         self.stop_event = self._ctx.Event()
@@ -630,7 +662,13 @@ class ProcessActorPool:
         if wid in self._queues:
             self._salvage_incarnation(wid)
         self._queues[wid] = self._ctx.Queue(maxsize=self._queue_size)
-        self._rings[wid] = ShmRing(self._ring_bytes)
+        self._rings[wid] = self._transport.make_channel(wid, attempt)
+        xp_spec = self._transport.endpoint(self._rings[wid], wid, attempt)
+        param_spec = (
+            {"kind": "shm", "name": self.buffer.name,
+             "capacity": self.buffer.capacity}
+            if self.buffer is not None else {"kind": "net"}
+        )
         self._stats_prev.pop(wid, None)  # fresh incarnation: rate resets
         try:
             self._stats_blocks[wid] = WorkerStatsBlock(
@@ -644,9 +682,8 @@ class ProcessActorPool:
             stats_name = None
         p = self._ctx.Process(
             target=_worker_main,
-            args=(wid, self._cfg_dict, self.num_workers, self.buffer.name,
-                  self.buffer.capacity, self._rings[wid].name,
-                  self._ring_bytes, self._queues[wid], self.stop_event,
+            args=(wid, self._cfg_dict, self.num_workers, param_spec,
+                  xp_spec, self._queues[wid], self.stop_event,
                   budget, self._quantum, attempt, self._seed_base,
                   self.cfg.actor.worker_nice, stats_name),
             daemon=True,
@@ -683,6 +720,7 @@ class ProcessActorPool:
             }
             ring.close()
             ring.unlink()
+            self._transport.drop_channel(wid, ring)
         # The dead incarnation's shm stats block is the post-mortem: final
         # slot values + the flight recorder's last events — readable even
         # after SIGKILL (the whole reason the block lives in /dev/shm).
@@ -729,20 +767,40 @@ class ProcessActorPool:
 
     def shm_accounting(self) -> dict:
         """Live fd/shm usage of the transport (logged by the fleet tools;
-        the config-side planning twin is ``config.transport_budget``)."""
+        the config-side planning twin is ``config.transport_budget``).
+        tcp mode holds no rings and no param buffer in /dev/shm — only
+        the per-worker stats blocks remain shm segments there."""
         import os as _os
 
         try:
             n_fds = len(_os.listdir("/proc/self/fd"))
         except OSError:
             n_fds = -1
+        shm_mode = self.buffer is not None
         return {
-            "shm_segments": 1 + len(self._rings) + len(self._stats_blocks),
-            "ring_bytes_each": self._ring_bytes,
-            "ring_bytes_total": self._ring_bytes * len(self._rings),
-            "param_buffer_bytes": self.buffer.capacity,
+            "transport": self._transport.kind,
+            "shm_segments": (
+                (1 + len(self._rings) if shm_mode else 0)
+                + len(self._stats_blocks)
+            ),
+            "ring_bytes_each": self._ring_bytes if shm_mode else 0,
+            "ring_bytes_total": (
+                self._ring_bytes * len(self._rings) if shm_mode else 0
+            ),
+            "param_buffer_bytes": self.buffer.capacity if shm_mode else 0,
             "process_fds": n_fds,
         }
+
+    def net_stats(self) -> dict:
+        """The obs ``net`` section (tcp backend: bytes/s, frames,
+        reconnects, torn frames, param fan-out cost per push) — empty
+        dict on the shm backend, so emit/obs surfaces stay unchanged
+        there."""
+        return self._transport.stats()
+
+    @property
+    def transport_kind(self) -> str:
+        return self._transport.kind
 
     def worker_stats(self, max_age_s: float = 0.5) -> dict:
         """Per-worker sweep of the shm stats blocks — env steps (+ a
@@ -792,19 +850,22 @@ class ProcessActorPool:
         stagger = (stagger_s if stagger_s is not None
                    else self.cfg.actor.spawn_stagger_s)
         # fd/shm budget gate: fail loudly BEFORE spawning a fleet whose
-        # rings cannot fit /dev/shm (256 workers × ring_bytes is real money).
-        need = self.num_workers * self._ring_bytes + self.buffer.capacity
-        try:
-            st = _os.statvfs("/dev/shm")
-            free = st.f_bavail * st.f_frsize
-        except OSError:
-            free = None
-        if free is not None and need > free:
-            raise RuntimeError(
-                f"experience-transport shm budget {need} bytes exceeds "
-                f"/dev/shm free space {free} — lower actor.xp_ring_bytes "
-                f"or actor.num_workers"
-            )
+        # rings cannot fit /dev/shm (256 workers × ring_bytes is real
+        # money).  tcp mode allocates no rings — experience bytes live in
+        # kernel socket buffers — so only the shm backend gates here.
+        if self.buffer is not None:
+            need = self.num_workers * self._ring_bytes + self.buffer.capacity
+            try:
+                st = _os.statvfs("/dev/shm")
+                free = st.f_bavail * st.f_frsize
+            except OSError:
+                free = None
+            if free is not None and need > free:
+                raise RuntimeError(
+                    f"experience-transport shm budget {need} bytes exceeds "
+                    f"/dev/shm free space {free} — lower actor.xp_ring_bytes "
+                    f"or actor.num_workers"
+                )
         for w in range(self.num_workers):
             self._procs.append(self._spawn(w, self.cfg.actor.T))
             if stagger and w + 1 < self.num_workers:
@@ -918,6 +979,9 @@ class ProcessActorPool:
         into the cached per-worker snapshot, as side effects."""
         import queue as queue_mod
 
+        # Accept/handshake/param-push pump (tcp backend; shm no-op): new
+        # worker connections route to their channels on the poll cadence.
+        self._transport.pump()
         self.worker_stats()  # throttled shm sweep rides the poll cadence
         out = list(self._salvaged)
         self._salvaged.clear()
@@ -988,6 +1052,7 @@ class ProcessActorPool:
         latency percentiles, ring-full backpressure events (live rings plus
         retired incarnations), torn-record salvage counts."""
         s = self.transport.summary()
+        s["transport"] = self._transport.kind
         s["ring_full_waits"] = self._full_waits_base + sum(
             r.full_waits for r in self._rings.values()
         )
@@ -1049,6 +1114,7 @@ class ProcessActorPool:
                 self.transport.count_salvage(0, torn=True)
             ring.close()
             ring.unlink()
+            self._transport.drop_channel(wid, ring)
         for wid in list(self._queues):
             try:
                 self._queues.pop(wid).close()
@@ -1058,7 +1124,9 @@ class ProcessActorPool:
             blk = self._stats_blocks.pop(wid)
             blk.close()
             blk.unlink()
-        self.buffer.close()
+        self._transport.close()
+        if self.buffer is not None:
+            self.buffer.close()
 
 
 class ProcessActorWorker:

@@ -1,0 +1,215 @@
+"""Pluggable experience transport — the seam between the process-actor
+pool and whatever carries its CRC-framed APXT record stream.
+
+Two backends (``actor.transport``):
+
+  * ``shm`` (default) — today's SIGKILL-safe per-incarnation shm ring,
+    UNTOUCHED: ``make_channel`` returns a plain ``ShmRing`` and the
+    worker attaches by segment name, so the default path is bit-for-bit
+    the pre-refactor behavior (tests/test_shm_ring.py and the
+    ``xp_transport`` bench run against exactly the same objects).
+    Params ride the pool's shared-memory seqlock buffer as before.
+  * ``tcp`` (runtime/net.py) — the identical framed records over a
+    nonblocking socket per worker, with a bounded per-connection drain
+    budget (``config.transport_budget`` arithmetic), torn/truncated
+    frames detected exactly like a torn ring tail, and
+    reconnect-with-backoff on the worker side.  Params ride the same
+    connection in reverse as delta-or-full framed messages
+    (``NetParamStore`` below), so fan-out cost is measurable per push.
+
+Both sides of the seam keep the ring's reader/writer surface
+(``read_next``/``torn_tail``/``committed``/``write``), which is what
+makes the pool's poll, salvage, lineage and stats paths
+backend-agnostic.  Import-light by construction (this module pulls in
+only shm_ring and net — stdlib + numpy): worker children import it
+before jax's backend is pinned.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from ape_x_dqn_tpu.runtime.net import NetTransport, NetWriter
+from ape_x_dqn_tpu.runtime.shm_ring import ShmRing
+
+TRANSPORT_KINDS = ("shm", "tcp")
+
+
+class ShmTransport:
+    """The zero-regression default: one ShmRing per worker incarnation,
+    created learner-side, attached by name worker-side."""
+
+    kind = "shm"
+
+    def __init__(self, ring_bytes: int):
+        self._ring_bytes = int(ring_bytes)
+
+    def make_channel(self, wid: int, attempt: int) -> ShmRing:
+        return ShmRing(self._ring_bytes)
+
+    def endpoint(self, channel: ShmRing, wid: int, attempt: int) -> dict:
+        return {"kind": "shm", "name": channel.name,
+                "capacity": self._ring_bytes}
+
+    def pump(self) -> None:  # nothing to accept/flush
+        pass
+
+    def drop_channel(self, wid: int, channel) -> None:  # no registry
+        pass
+
+    def stats(self) -> dict:
+        return {}
+
+    def close(self) -> None:
+        pass
+
+
+class TcpTransport:
+    """TCP backend: wraps the learner-side NetTransport (listener +
+    per-worker channels + param fan-out)."""
+
+    kind = "tcp"
+
+    def __init__(self, host: str, port: int, drain_budget_per_conn: int,
+                 conn_buf_bytes: int):
+        self.net = NetTransport(
+            host=host, port=port,
+            drain_budget_per_conn=drain_budget_per_conn,
+            conn_buf_bytes=conn_buf_bytes,
+        )
+
+    @property
+    def port(self) -> int:
+        return self.net.port
+
+    def make_channel(self, wid: int, attempt: int):
+        return self.net.make_channel(wid, attempt)
+
+    def endpoint(self, channel, wid: int, attempt: int) -> dict:
+        # Workers connect BACK to the learner host; a bound-to-all
+        # listener (0.0.0.0) cannot be dialed literally, so advertise
+        # loopback for the local-spawn case (a genuinely remote worker
+        # gets the learner's routable address from its operator/config).
+        host = self.net.host
+        if host in ("0.0.0.0", "::"):
+            host = "127.0.0.1"
+        return {
+            "kind": "tcp", "host": host, "port": self.net.port,
+            "token": self.net.token, "wid": int(wid),
+            "attempt": int(attempt),
+        }
+
+    def pump(self) -> None:
+        self.net.pump()
+
+    def drop_channel(self, wid: int, channel) -> None:
+        self.net.drop_channel(wid, channel)
+
+    def stats(self) -> dict:
+        return self.net.stats()
+
+    def close(self) -> None:
+        self.net.close()
+
+
+def make_transport(cfg, num_workers: int, ring_bytes: int,
+                   drain_budget_bytes: int):
+    """Backend from config.  The per-connection drain bound reuses the
+    ``transport_budget`` arithmetic: the poll sweep's byte budget split
+    across the fleet, floored at one ring-record's worth."""
+    kind = getattr(cfg.actor, "transport", "shm")
+    if kind == "shm":
+        return ShmTransport(ring_bytes)
+    if kind == "tcp":
+        per_conn = max(64 << 10,
+                       int(drain_budget_bytes) // max(1, int(num_workers)))
+        return TcpTransport(
+            host=cfg.actor.transport_host,
+            port=cfg.actor.transport_port,
+            drain_budget_per_conn=per_conn,
+            conn_buf_bytes=cfg.actor.net_conn_buf_bytes,
+        )
+    raise ValueError(f"unknown actor.transport: {kind}")
+
+
+def connect_channel(spec: dict):
+    """Worker-side attach: the writer end matching a learner endpoint
+    spec — a name-attached ShmRing or a reconnecting NetWriter, both
+    exposing ``write(parts, should_stop, ...)``."""
+    if spec["kind"] == "shm":
+        return ShmRing(spec["capacity"], name=spec["name"], create=False)
+    if spec["kind"] == "tcp":
+        return NetWriter(spec)
+    raise ValueError(f"unknown transport endpoint kind: {spec['kind']}")
+
+
+class NetParamStore:
+    """ParamStore facade whose publishes fan out over the TCP transport —
+    the socket twin of SharedMemoryParamStore (same surface: ``publish``
+    / ``get`` / ``get_blocking`` / ``version``), so one runtime code
+    path drives both process-actor transports.  Each publish serializes
+    once and pushes delta-or-full frames to every connected worker; the
+    per-push cost lands on the transport's ``net`` stats."""
+
+    def __init__(self, transport: TcpTransport):
+        import threading
+
+        self._net = transport.net
+        self._lock = threading.Lock()
+        self._params = None  # host copy for in-process readers
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def publish(self, params: Any) -> int:
+        import jax
+
+        from ape_x_dqn_tpu.utils.serialization import tree_to_bytes
+
+        host = jax.device_get(params)
+        payload = tree_to_bytes(host)
+        with self._lock:
+            self._params = host
+            self._version += 1
+            self._net.set_params(payload, self._version)
+            return self._version
+
+    def get(self, have_version: int = -1):
+        with self._lock:
+            if self._params is None or self._version <= have_version:
+                return None
+            return self._params, self._version
+
+    def get_blocking(self, timeout: float = 30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            got = self.get(-1)
+            if got is not None:
+                return got
+            time.sleep(0.01)
+        raise TimeoutError("no parameters published within timeout")
+
+
+class NetParamSource:
+    """Worker-side ``ParamSource`` over the experience connection: pump
+    incoming delta/full frames, deserialize into the worker's template on
+    version change (pool.py's ``sync_params`` contract)."""
+
+    def __init__(self, writer: NetWriter, template: Any):
+        self._writer = writer
+        self._template = template
+
+    def get(self, have_version: int = -1):
+        self._writer.pump_params()
+        got = self._writer.latest_params()
+        if got is None:
+            return None
+        payload, version = got
+        if version <= have_version:
+            return None
+        from ape_x_dqn_tpu.utils.serialization import restore_like
+
+        return restore_like(self._template, payload), version

@@ -157,6 +157,25 @@ def _xp_transport_bench(workers=(4, 16, 64), seconds: float = 3.0,
     return out
 
 
+def _xp_net_bench(workers=(4, 16, 64), seconds: float = 3.0,
+                  rows: int = 64, obs_shape=(84, 84, 1)) -> dict:
+    """``xp_net``: shm ring vs the TCP transport backend on loopback
+    (ISSUE 8) — the identical CRC-framed APXT records through
+    runtime/net.py's socket path, at three fleet widths.  Loopback is
+    the cross-host transport's upper bound: it pays the framing, crc,
+    kernel socket path and per-frame copies, but no wire latency.
+
+    Host-only by construction (tools/xp_transport.py loads shm_ring.py
+    and net.py by file path; no process imports jax), so the section
+    survives TPU-tunnel outages alongside xp_transport.
+    """
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tools.xp_transport import run_net_bench
+
+    return run_net_bench(list(workers), seconds=seconds, rows=rows,
+                         obs_shape=tuple(obs_shape))
+
+
 def _pipeline_overlap_bench(steps: int = 6400, steps_per_call: int = 64,
                             sync_every: int = 1024,
                             timeout_s: float = 900.0) -> dict:
@@ -1079,6 +1098,9 @@ def main() -> None:
                         default=1024)
     parser.add_argument("--skip-xp-transport", action="store_true",
                         help="skip the shm-ring vs mp.Queue transport bench")
+    parser.add_argument("--skip-xp-net", action="store_true",
+                        help="skip the shm-ring vs TCP-loopback transport "
+                        "bench (xp_net)")
     parser.add_argument("--xp-workers", default="4,16,64",
                         help="comma-separated producer counts for "
                         "xp_transport")
@@ -1207,6 +1229,13 @@ def main() -> None:
         # Host-only (no jax in any producer/consumer): the actor→learner
         # transport in isolation, shm ring vs mp.Queue, + SIGKILL barrage.
         section("xp_transport", _xp_transport_bench,
+                workers=tuple(int(w) for w in args.xp_workers.split(",")),
+                seconds=args.xp_seconds)
+    if not args.skip_xp_net:
+        # Host-only (no jax in any producer/consumer): shm ring vs the
+        # TCP backend over loopback — the cost of leaving /dev/shm
+        # (ISSUE 8; demos/xp_net.json is the committed point set).
+        section("xp_net", _xp_net_bench,
                 workers=tuple(int(w) for w in args.xp_workers.split(",")),
                 seconds=args.xp_seconds)
     if not args.skip_replay_tiered:

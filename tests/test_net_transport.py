@@ -1,0 +1,567 @@
+"""TCP experience-transport tests: the framing's adversarial decode
+matrix (the socket mirror of tests/test_shm_ring.py's torn-tail matrix),
+the param delta codec, channel hijack/reconnect interleaving, the
+pool-level salvage discipline on the tcp backend, the per_host transport
+budget, and the cross-host clock-skew clamp."""
+
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.runtime.net import (
+    _FRAME,
+    _HELLO,
+    _NET_MAGIC,
+    _NET_VERSION,
+    F_XP,
+    Backoff,
+    FrameParser,
+    NetTransport,
+    NetWriter,
+    apply_param_delta,
+    build_param_delta,
+    build_param_full,
+    frame_bytes,
+)
+from ape_x_dqn_tpu.runtime.shm_ring import XP, decode_chunk, encode_chunk_parts
+
+
+def _frames(*payloads, start_seq=1):
+    return b"".join(
+        frame_bytes(F_XP, start_seq + i, [p]) for i, p in enumerate(payloads)
+    )
+
+
+class TestFrameParserAdversarial:
+    """Truncation/corruption matrix: every fault is detected (parser
+    error or pending tail), nothing invalid is ever yielded — the
+    stream-level torn-ring-tail contract."""
+
+    def test_roundtrip_and_order(self):
+        p = FrameParser()
+        p.feed(_frames(b"one", b"two", b"three"))
+        got = [p.next() for _ in range(3)]
+        assert [x[1] for x in got] == [b"one", b"two", b"three"]
+        assert p.next() is None and p.error is None
+
+    def test_truncation_mid_length_prefix(self):
+        p = FrameParser()
+        whole = _frames(b"committed", b"torn-after-this")
+        p.feed(whole[:len(_frames(b"committed")) + 3])  # 3 B of next header
+        assert p.next()[1] == b"committed"
+        assert p.next() is None           # incomplete header: nothing out
+        assert p.error is None
+        assert p.pending() == 3           # the torn tail, detectable
+
+    def test_truncation_mid_payload(self):
+        p = FrameParser()
+        whole = _frames(b"x" * 1000)
+        p.feed(whole[:_FRAME.size + 137])
+        assert p.next() is None
+        assert p.pending() == _FRAME.size + 137
+
+    def test_crc_bitflip_detected(self):
+        buf = bytearray(_frames(b"a" * 600))
+        buf[_FRAME.size + 300] ^= 0x40    # flip one payload bit
+        p = FrameParser()
+        p.feed(bytes(buf))
+        assert p.next() is None
+        assert p.error == "crc"
+        p.feed(_frames(b"late", start_seq=2))
+        assert p.next() is None           # dead stream yields nothing more
+
+    def test_seq_skip_detected(self):
+        p = FrameParser()
+        p.feed(frame_bytes(F_XP, 1, [b"one"]))
+        p.feed(frame_bytes(F_XP, 3, [b"skipped-two"]))
+        assert p.next()[1] == b"one"
+        assert p.next() is None
+        assert p.error == "seq"
+
+    def test_absurd_length_prefix_rejected(self):
+        p = FrameParser()
+        p.feed(_FRAME.pack(1 << 31, 0, 1, F_XP))
+        assert p.next() is None
+        assert p.error == "length"
+
+    def test_byte_dribble_reassembles(self):
+        """One byte at a time — frames only emerge complete and verified."""
+        whole = _frames(b"dribbled-payload" * 10)
+        p = FrameParser()
+        out = []
+        for i in range(len(whole)):
+            p.feed(whole[i:i + 1])
+            got = p.next()
+            if got is not None:
+                out.append(got[1])
+        assert out == [b"dribbled-payload" * 10]
+
+
+class TestParamDelta:
+    def test_delta_roundtrip(self):
+        rng = np.random.default_rng(0)
+        prev = rng.integers(0, 255, 300_000, dtype=np.uint8).tobytes()
+        new = bytearray(prev)
+        new[1000:1032] = b"\x7f" * 32      # one dirty page
+        new = bytes(new)
+        d = build_param_delta(7, 6, prev, new)
+        assert d is not None and len(d) < len(new) // 4
+        version, base, blob = apply_param_delta(prev, d)
+        assert (version, base) == (7, 6)
+        assert blob == new
+
+    def test_delta_falls_back_to_full_when_everything_moved(self):
+        rng = np.random.default_rng(1)
+        prev = rng.integers(0, 255, 100_000, dtype=np.uint8).tobytes()
+        new = rng.integers(0, 255, 100_000, dtype=np.uint8).tobytes()
+        assert build_param_delta(2, 1, prev, new) is None
+        assert build_param_delta(2, 1, prev, prev + b"x") is None  # size
+
+    def test_delta_crc_mismatch_raises(self):
+        prev = bytes(200_000)
+        new = bytearray(prev)
+        new[5] = 1
+        d = bytearray(build_param_delta(3, 2, prev, bytes(new)))
+        d[-1] ^= 0x01                      # corrupt a patched page byte
+        with pytest.raises(ValueError, match="crc"):
+            apply_param_delta(prev, bytes(d))
+        with pytest.raises(ValueError):    # wrong baseline blob
+            apply_param_delta(bytes(199_999), bytes(d))
+
+    def test_full_frame_layout(self):
+        payload = build_param_full(9, b"blob-bytes")
+        (v,) = struct.unpack_from("<q", payload, 0)
+        assert v == 9 and payload[8:] == b"blob-bytes"
+
+
+def _hello(tr, wid=0, attempt=0, token=None, version=_NET_VERSION):
+    return _HELLO.pack(_NET_MAGIC, version, wid, attempt,
+                       tr.token if token is None else token)
+
+
+def _connect_raw(tr, **kw):
+    s = socket.create_connection(("127.0.0.1", tr.port), timeout=5)
+    s.sendall(_hello(tr, **kw))
+    return s
+
+
+def _pump_until(tr, cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        tr.pump()
+        if cond():
+            return
+        time.sleep(0.01)
+    raise TimeoutError("condition not reached")
+
+
+class TestNetTransportChannel:
+    def test_handshake_routes_and_reads(self):
+        tr = NetTransport()
+        try:
+            ch = tr.make_channel(0, 0)
+            s = _connect_raw(tr)
+            _pump_until(tr, lambda: ch.connected)
+            s.sendall(_frames(b"r1", b"r2"))
+            got = []
+            deadline = time.monotonic() + 5
+            while len(got) < 2 and time.monotonic() < deadline:
+                rec = ch.read_next()
+                if rec is not None:
+                    got.append(rec)
+            assert got == [b"r1", b"r2"]
+            assert ch.committed == 2 and not ch.torn_tail()
+            s.close()
+        finally:
+            tr.close()
+
+    def test_bad_token_and_stale_attempt_rejected(self):
+        tr = NetTransport()
+        try:
+            ch = tr.make_channel(0, 1)
+            s1 = _connect_raw(tr, token=12345)       # wrong run
+            s2 = _connect_raw(tr, attempt=0)         # stale incarnation
+            s3 = _connect_raw(tr, wid=9)             # unknown worker
+            _pump_until(tr, lambda: tr.rejects >= 3)
+            assert not ch.connected
+            for s in (s1, s2, s3):
+                s.close()
+        finally:
+            tr.close()
+
+    def test_disconnect_mid_payload_is_torn_never_delivered(self):
+        tr = NetTransport()
+        try:
+            ch = tr.make_channel(0, 0)
+            s = _connect_raw(tr)
+            _pump_until(tr, lambda: ch.connected)
+            s.sendall(_frames(b"whole-record"))
+            partial = frame_bytes(F_XP, 2, [b"y" * 4096])[:200]
+            s.sendall(partial)
+            time.sleep(0.2)
+            s.close()
+            # Salvage sweep: the committed record arrives, the torn tail
+            # never does, and the tear is counted.
+            deadline = time.monotonic() + 5
+            got = []
+            while time.monotonic() < deadline:
+                rec = ch.read_next()
+                if rec is not None:
+                    got.append(rec)
+                if not ch.connected and ch.read_next() is None:
+                    break
+                time.sleep(0.01)
+            assert got == [b"whole-record"]
+            assert ch.torn_tail() and ch.torn_live >= 1
+        finally:
+            tr.close()
+
+    def test_interleaved_reconnect_fresh_seq_stream(self):
+        """Connection A delivers, dies mid-frame; connection B (same
+        worker, fresh hello) adopts with a FRESH seq stream — its frames
+        deliver, A's torn tail is counted, nothing interleaves."""
+        tr = NetTransport()
+        try:
+            ch = tr.make_channel(3, 2)
+            a = _connect_raw(tr, wid=3, attempt=2)
+            _pump_until(tr, lambda: ch.connected)
+            a.sendall(_frames(b"from-A-1", b"from-A-2"))
+            a.sendall(frame_bytes(F_XP, 3, [b"A-torn" * 500])[:50])
+            deadline = time.monotonic() + 5
+            got = []
+            while len(got) < 2 and time.monotonic() < deadline:
+                rec = ch.read_next()
+                if rec is not None:
+                    got.append(rec)
+            b = _connect_raw(tr, wid=3, attempt=2)   # reconnect
+            _pump_until(tr, lambda: ch.reconnects >= 1)
+            a.close()
+            b.sendall(_frames(b"from-B-1"))          # seq restarts at 1
+            deadline = time.monotonic() + 5
+            while len(got) < 3 and time.monotonic() < deadline:
+                rec = ch.read_next()
+                if rec is not None:
+                    got.append(rec)
+            assert got == [b"from-A-1", b"from-A-2", b"from-B-1"]
+            assert ch.torn_frames >= 1           # A's tail, counted at adopt
+            b.close()
+        finally:
+            tr.close()
+
+    def test_writer_reconnects_after_channel_drop(self):
+        """NetWriter survives its connection being closed learner-side:
+        backoff, reconnect, stream resumes.  (The ONE frame in flight at
+        the drop may be lost or duplicated — the documented connection-
+        loss contract — so the assertion is resumption, not exactly-once
+        across the drop.)"""
+        tr = NetTransport()
+        try:
+            ch = tr.make_channel(0, 0)
+            w = NetWriter({"host": "127.0.0.1", "port": tr.port,
+                           "token": tr.token, "wid": 0, "attempt": 0})
+            assert w.write([b"first"], timeout=5)
+            _pump_until(tr, lambda: ch.read_next() == b"first")
+            # Drop the learner-side socket under the writer.
+            with ch._send_lock:
+                ch._retire_conn_locked()
+            got = []
+            deadline = time.monotonic() + 15
+            i = 0
+            while not got and time.monotonic() < deadline:
+                assert w.write([b"resent-%d" % i], timeout=10)
+                i += 1
+                tr.pump()
+                rec = ch.read_next()
+                if rec is not None:
+                    got.append(rec)
+            assert got and got[0].startswith(b"resent-")
+            assert w.reconnects >= 1
+            w.close()
+        finally:
+            tr.close()
+
+    def test_param_fanout_full_then_delta(self):
+        tr = NetTransport()
+        try:
+            tr.make_channel(0, 0)
+            w = NetWriter({"host": "127.0.0.1", "port": tr.port,
+                           "token": tr.token, "wid": 0, "attempt": 0})
+            assert w.write([b"hello-record"], timeout=5)  # connects
+            rng = np.random.default_rng(2)
+            blob1 = rng.integers(0, 255, 500_000, dtype=np.uint8).tobytes()
+            blob2 = bytearray(blob1)
+            blob2[100:132] = b"\x01" * 32
+            blob2 = bytes(blob2)
+            _pump_until(tr, lambda: tr.stats()["connections"] == 1)
+            push1 = tr.set_params(blob1, 1)
+            assert push1["full"] == 1 and push1["delta"] == 0
+            deadline = time.monotonic() + 5
+            while (w.latest_params() or (None, -1))[1] < 1 \
+                    and time.monotonic() < deadline:
+                w.pump_params()
+                time.sleep(0.01)
+            assert w.latest_params() == (blob1, 1)
+            push2 = tr.set_params(blob2, 2)
+            assert push2["delta"] == 1 and push2["full"] == 0
+            assert push2["bytes"] < len(blob2) // 4   # delta-sized fan-out
+            assert push2["fanout_ms"] >= 0
+            deadline = time.monotonic() + 5
+            while w.latest_params()[1] < 2 and time.monotonic() < deadline:
+                w.pump_params()
+                time.sleep(0.01)
+            assert w.latest_params() == (blob2, 2)    # patched bit-exactly
+            s = tr.stats()
+            assert s["param_pushes"] == 2
+            assert s["param_delta"] == 1 and s["param_full"] == 1
+            w.close()
+        finally:
+            tr.close()
+
+
+class TestPoolTcpBackend:
+    """Pool-level discipline on the tcp backend, no real jax workers —
+    the mirror of TestSigkillMidWrite.test_pool_salvage_gives_respawn
+    _fresh_ring: committed records salvage into poll(), the torn tail is
+    counted, the respawned incarnation gets a FRESH channel."""
+
+    def _pool(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+        from ape_x_dqn_tpu.runtime.process_actors import ProcessActorPool
+
+        cfg = ApexConfig()
+        cfg.network = "mlp"
+        cfg.env.name = "chain:6"
+        cfg.actor.mode = "process"
+        cfg.actor.transport = "tcp"
+        cfg.actor.num_workers = 1
+        cfg.actor.num_actors = 2
+        cfg.validate()
+        return ProcessActorPool(cfg, num_workers=1, ring_bytes=1 << 16)
+
+    def test_pool_salvage_counts_torn_and_retires_channel(self):
+        from ape_x_dqn_tpu.runtime.transport import connect_channel
+
+        pool = self._pool()
+        try:
+            assert pool.buffer is None      # params ride the connections
+            pool._queues[0] = pool._ctx.Queue(maxsize=4)
+            pool._rings[0] = pool._transport.make_channel(0, 0)
+            spec = pool._transport.endpoint(pool._rings[0], 0, 0)
+            w = connect_channel(spec)
+            arrays = {"prio": np.ones(2, np.float32),
+                      "obs": np.zeros((2, 3), np.uint8),
+                      "action": np.zeros(2, np.int32),
+                      "reward": np.zeros(2, np.float32),
+                      "discount": np.ones(2, np.float32),
+                      "next_obs": np.zeros((2, 3), np.uint8)}
+            assert w.write(encode_chunk_parts(XP, 5, 2, arrays), timeout=5)
+            assert w.write(encode_chunk_parts(XP, 6, 2, arrays), timeout=5)
+            # Route the hello (poll() does this continuously in the real
+            # pool); then the torn tail: a partial frame straight on the
+            # writer's socket, then the "kill" (abrupt close).  Salvage
+            # itself drains the kernel buffer — committed records first,
+            # then the tear.
+            _pump_until(pool._transport,
+                        lambda: pool._rings[0].connected)
+            time.sleep(0.3)
+            w._sock.sendall(
+                frame_bytes(F_XP, 3, [b"z" * 2048])[:100]
+            )
+            time.sleep(0.2)
+            w._sock.close()
+            time.sleep(0.2)
+            pool._salvage_incarnation(0)
+            salvaged = pool._salvaged
+            assert len(salvaged) == 2
+            stats = pool.transport_stats()
+            assert stats["transport"] == "tcp"
+            assert stats["torn_records"] == 1
+            items = pool.poll(max_items=8)
+            assert len(items) == 2
+            assert pool.last_versions[0] == 6
+            assert 0 not in pool._rings
+        finally:
+            pool.stop(join_timeout=1.0)
+
+    def test_decoded_chunk_identical_to_shm_wire(self):
+        """The tcp payload IS the ring record payload: decode_chunk sees
+        byte-identical envelopes + arrays either way."""
+        from ape_x_dqn_tpu.runtime.transport import connect_channel
+
+        pool = self._pool()
+        try:
+            pool._queues[0] = pool._ctx.Queue(maxsize=4)
+            pool._rings[0] = pool._transport.make_channel(0, 0)
+            spec = pool._transport.endpoint(pool._rings[0], 0, 0)
+            w = connect_channel(spec)
+            rng = np.random.default_rng(7)
+            arrays = {"prio": rng.random(3).astype(np.float32),
+                      "obs": rng.integers(0, 255, (3, 4, 4, 1),
+                                          dtype=np.uint8),
+                      "action": np.arange(3, dtype=np.int32),
+                      "reward": rng.normal(size=3).astype(np.float32),
+                      "discount": np.full(3, 0.97, np.float32),
+                      "next_obs": rng.integers(0, 255, (3, 4, 4, 1),
+                                               dtype=np.uint8)}
+            parts = encode_chunk_parts(XP, 11, 3, arrays, trace_id=0xF00)
+            wire = b"".join(
+                bytes(memoryview(p).cast("B")) if not isinstance(p, bytes)
+                else p for p in parts
+            )
+            assert w.write(parts, timeout=5)
+            deadline = time.monotonic() + 5
+            rec = None
+            while rec is None and time.monotonic() < deadline:
+                pool._transport.pump()
+                rec = pool._rings[0].read_next()
+                time.sleep(0.01)
+            assert rec == wire              # byte-for-byte the APXT record
+            kind, ver, _, steps, _, _, _, tid, back = decode_chunk(rec)
+            assert (kind, ver, steps, tid) == (XP, 11, 3, 0xF00)
+            for k, v in arrays.items():
+                np.testing.assert_array_equal(back[k], v)
+            w.close()
+        finally:
+            pool.stop(join_timeout=1.0)
+
+
+class TestRetiredChannelAccounting:
+    def test_stats_survive_channel_retirement(self):
+        """Cumulative transport counters must NOT vanish when a channel
+        retires (respawn/stop): the final JSONL emit happens after
+        pool.stop(), and a run that moved thousands of frames must not
+        report frames_in=0 there (found driving the real CLI)."""
+        tr = NetTransport()
+        try:
+            ch = tr.make_channel(0, 0)
+            s = _connect_raw(tr)
+            _pump_until(tr, lambda: ch.connected)
+            s.sendall(_frames(b"a", b"b"))
+            deadline = time.monotonic() + 5
+            n = 0
+            while n < 2 and time.monotonic() < deadline:
+                if ch.read_next() is not None:
+                    n += 1
+            s.close()
+            ch.close()
+            tr.drop_channel(0, ch)
+            stats = tr.stats()
+            assert stats["expected"] == 0
+            assert stats["frames_in"] == 2       # history folded, not lost
+            assert stats["bytes_in"] > 0
+        finally:
+            tr.close()
+        assert tr.stats()["frames_in"] == 2      # and survives close()
+
+
+class TestBackoff:
+    def test_backoff_doubles_and_caps(self):
+        b = Backoff(base_s=0.1, max_s=0.4, jitter=0.0)
+        assert b.ready()
+        b.fail()
+        assert not b.ready()
+        t0 = time.monotonic()
+        while not b.ready():
+            time.sleep(0.005)
+        assert 0.05 < time.monotonic() - t0 < 0.3
+        b.fail(), b.fail(), b.fail(), b.fail()
+        assert b._next_ok - time.monotonic() <= 0.45  # capped
+        b.reset()
+        assert b.ready()
+
+
+class TestTransportBudgetPerHost:
+    def test_shm_budget_is_local_host_only(self):
+        from ape_x_dqn_tpu.config import ApexConfig, transport_budget
+
+        cfg = ApexConfig()
+        cfg.actor.xp_ring_bytes = 1 << 20
+        b = transport_budget(cfg, num_workers=256)
+        # Legacy arithmetic unchanged (the pre-seam pins hold)...
+        assert b["shm_segments"] == 257
+        assert b["ring_bytes_total"] == 256 << 20
+        # ...and the breakdown makes the single-/dev/shm assumption
+        # EXPLICIT: every ring byte on host 0, none anywhere else.
+        assert b["transport"] == "shm" and b["hosts"] == 1
+        assert len(b["per_host"]) == 1
+        assert b["per_host"][0]["shm_bytes"] == 256 << 20
+        assert b["per_host"][0]["sock_buf_bytes"] == 0
+
+    def test_tcp_budget_splits_hosts_sockets_not_shm(self):
+        from ape_x_dqn_tpu.config import ApexConfig, transport_budget
+
+        cfg = ApexConfig()
+        cfg.actor.transport = "tcp"
+        cfg.actor.transport_hosts = 4
+        cfg.actor.net_conn_buf_bytes = 1 << 20
+        cfg.actor.xp_drain_budget_bytes = 64 << 20
+        cfg.validate()
+        b = transport_budget(cfg, num_workers=64)
+        assert b["ring_bytes_total"] == 0 and b["shm_segments"] == 0
+        hosts = b["per_host"]
+        assert len(hosts) == 4
+        assert sum(h["workers"] for h in hosts) == 64
+        assert all(h["shm_bytes"] == 0 for h in hosts)  # no rings anywhere
+        # Learner host carries a receive buffer per connection on top of
+        # its local workers' send buffers; pure worker hosts only theirs.
+        assert hosts[0]["sock_buf_bytes"] == (16 + 64) << 20
+        assert hosts[1]["sock_buf_bytes"] == 16 << 20
+        # Per-connection drain bound = sweep budget / fleet width.
+        assert hosts[0]["conn_drain_budget_bytes"] == 1 << 20
+
+    def test_tcp_knob_validation(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+
+        cfg = ApexConfig()
+        cfg.actor.transport = "bogus"
+        with pytest.raises(ValueError, match="actor.transport"):
+            cfg.validate()
+        cfg = ApexConfig()
+        cfg.actor.transport_hosts = 2      # shm cannot leave the host
+        with pytest.raises(ValueError, match="transport_hosts"):
+            cfg.validate()
+        cfg = ApexConfig()
+        cfg.actor.transport = "tcp"
+        cfg.actor.transport_port = 99999
+        with pytest.raises(ValueError, match="transport_port"):
+            cfg.validate()
+        cfg = ApexConfig()
+        cfg.actor.transport = "tcp"
+        cfg.actor.net_conn_buf_bytes = 1024
+        with pytest.raises(ValueError, match="net_conn_buf_bytes"):
+            cfg.validate()
+
+
+class TestClockSkewClamp:
+    def test_future_t_act_clamped_and_counted(self):
+        """A remote host's monotonic clock running ahead stamps t_act in
+        our future; the span is clamped at zero age and counted, never
+        emitted negative."""
+        from ape_x_dqn_tpu.obs.lineage import LineageTracker
+
+        events = []
+        lt = LineageTracker(
+            64, emit=lambda name, **kw: events.append((name, kw))
+        )
+        skewed = time.monotonic() + 3600.0   # one hour ahead
+        lt.on_ingest(np.arange(4), t_act=skewed, trace_id=77, wid=0)
+        assert lt.clock_skew_clamped == 1
+        lt.on_sample(np.arange(4))
+        lt.on_trained(np.arange(4))
+        assert lt.completed_count == 1
+        (_, span), = events
+        assert span["act_to_ingest_ms"] >= 0.0
+        assert span["act_to_trained_ms"] >= 0.0
+        assert span["t_act"] <= span["t_ingest"]
+        assert lt.summary()["clock_skew_clamped"] == 1
+
+    def test_sane_t_act_not_clamped(self):
+        from ape_x_dqn_tpu.obs.lineage import LineageTracker
+
+        lt = LineageTracker(64)
+        lt.on_ingest(np.arange(4), t_act=time.monotonic() - 0.5,
+                     trace_id=5, wid=0)
+        assert lt.clock_skew_clamped == 0

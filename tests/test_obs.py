@@ -433,6 +433,21 @@ class TestMetricsDocSchema:
                     "max_ms"):
             assert key in stats["fault_ms"], key
 
+    def test_net_section_matches_doc(self):
+        """The net-transport schema rows (ISSUE 8 satellite): the
+        documented key list IS the stats dict that rides the JSONL
+        ``net`` section and the /varz provider on the tcp backend."""
+        from ape_x_dqn_tpu.runtime.net import NetTransport
+
+        doc = _doc_keys("## Net transport schema")
+        assert doc, "Net transport schema doc section missing"
+        tr = NetTransport()
+        try:
+            stats = tr.stats()
+        finally:
+            tr.close()
+        assert set(doc) == set(stats), set(doc) ^ set(stats)
+
 
 @pytest.fixture(scope="module")
 def tiny_thread_run():

@@ -30,7 +30,15 @@
 #         mid-spill: the committed chain restores bit-exactly (cold
 #         spans adopted in place, CRC-verified) and trains past the
 #         restored step — tools/spill_smoke.py.
-# Gate 8: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
+# Gate 8: network-transport smoke — the process-actor pipeline on the
+#         TCP experience backend (actor.transport=tcp, loopback): every
+#         non-shm worker contributes verified non-torn chunks to real
+#         training steps, an injected partial frame is detected as torn
+#         and never ingested, the displaced worker reconnects and
+#         resumes, a SIGKILLed worker respawns onto a fresh connection,
+#         and param fan-out cost is recorded per push
+#         (tools/net_smoke.py).
+# Gate 9: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
 #         command changes, change it HERE too (they must stay
 #         character-identical modulo this wrapper's cd).
 cd "$(dirname "$0")/.." || exit 1
@@ -41,4 +49,5 @@ timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/obs_smoke.py > /tmp/_t1_obs
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/pipeline_smoke.py --steps 2048 > /tmp/_t1_pipe.log 2>&1 || { echo "pipeline smoke FAILED:"; cat /tmp/_t1_pipe.log; exit 1; }
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py > /tmp/_t1_chaos.log 2>&1 || { echo "chaos smoke FAILED:"; cat /tmp/_t1_chaos.log; exit 1; }
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/spill_smoke.py > /tmp/_t1_spill.log 2>&1 || { echo "spill smoke FAILED:"; cat /tmp/_t1_spill.log; exit 1; }
+timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/net_smoke.py > /tmp/_t1_net.log 2>&1 || { echo "net smoke FAILED:"; cat /tmp/_t1_net.log; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -30,19 +30,29 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-_SHM_RING_PATH = os.path.abspath(os.path.join(
+_RUNTIME_DIR = os.path.abspath(os.path.join(
     os.path.dirname(os.path.abspath(__file__)), os.pardir,
-    "ape_x_dqn_tpu", "runtime", "shm_ring.py",
+    "ape_x_dqn_tpu", "runtime",
 ))
+_SHM_RING_PATH = os.path.join(_RUNTIME_DIR, "shm_ring.py")
+_NET_PATH = os.path.join(_RUNTIME_DIR, "net.py")
+
+
+def _load_by_path(name: str, path: str):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def load_shm_ring():
     """shm_ring as a standalone module (no package import, no jax)."""
-    spec = importlib.util.spec_from_file_location("_apex_shm_ring",
-                                                  _SHM_RING_PATH)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    return _load_by_path("_apex_shm_ring", _SHM_RING_PATH)
+
+
+def load_net():
+    """net as a standalone module (no package import, no jax)."""
+    return _load_by_path("_apex_net", _NET_PATH)
 
 
 def _make_arrays(wid: int, rows: int, obs_shape) -> Dict[str, np.ndarray]:
@@ -105,6 +115,29 @@ def _ring_producer(ring_name: str, capacity: int, wid: int, rows: int,
         ring.close()
 
 
+def _net_producer(host: str, port: int, token: int, wid: int, rows: int,
+                  obs_shape, stop_evt, nice: int = 10) -> None:
+    """Chunks over the TCP transport (runtime/net.py loaded by path),
+    the production encode path — byte-identical frames to what a remote
+    worker on another host would send."""
+    _nice(nice)
+    ring_mod = load_shm_ring()
+    net_mod = load_net()
+    w = net_mod.NetWriter({"host": host, "port": port, "token": token,
+                           "wid": wid, "attempt": 0})
+    arrays = _make_arrays(wid, rows, obs_shape)
+    seq = 0
+    try:
+        while not stop_evt.is_set():
+            parts = ring_mod.encode_chunk_parts(ring_mod.XP, seq, rows,
+                                                arrays)
+            if not w.write(parts, should_stop=stop_evt.is_set):
+                break
+            seq += 1
+    finally:
+        w.close()
+
+
 def _spawn_all(ctx, target, argss):
     procs = []
     for args in argss:
@@ -129,6 +162,7 @@ def run_transport_point(transport: str, workers: int, seconds: float,
     mod = load_shm_ring()
     rings: List = []
     queues: List = []
+    net_tr = None
     if transport == "shm_ring":
         rings = [mod.ShmRing(ring_bytes) for _ in range(workers)]
         procs = _spawn_all(ctx, _ring_producer, [
@@ -140,6 +174,19 @@ def run_transport_point(transport: str, workers: int, seconds: float,
         procs = _spawn_all(ctx, _queue_producer, [
             (q, w, rows, obs_shape, stop_evt) for w, q in enumerate(queues)
         ])
+    elif transport == "tcp_loopback":
+        net_mod = load_net()
+        # Per-connection drain bound: the pool's transport_budget
+        # arithmetic (sweep budget / fleet width) at the default budget.
+        net_tr = net_mod.NetTransport(
+            drain_budget_per_conn=max(64 << 10, (64 << 20) // workers),
+        )
+        rings = [net_tr.make_channel(w, 0) for w in range(workers)]
+        procs = _spawn_all(ctx, _net_producer, [
+            ("127.0.0.1", net_tr.port, net_tr.token, w, rows, obs_shape,
+             stop_evt)
+            for w in range(workers)
+        ])
     else:
         raise ValueError(f"unknown transport {transport}")
 
@@ -150,9 +197,11 @@ def run_transport_point(transport: str, workers: int, seconds: float,
 
     def consume_once() -> Optional[tuple]:
         """(wid, nbytes, rows) of one chunk, or None if nothing ready."""
+        if net_tr is not None:
+            net_tr.pump()  # accept/handshake on the consume cadence
         for i in range(workers):
             w = (rr[0] + i) % workers
-            if transport == "shm_ring":
+            if transport in ("shm_ring", "tcp_loopback"):
                 rec = rings[w].read_next()
                 if rec is None:
                     continue
@@ -213,6 +262,8 @@ def run_transport_point(transport: str, workers: int, seconds: float,
         for r in rings:
             r.close()
             r.unlink()
+        if net_tr is not None:
+            net_tr.close()
     return {
         "transport": transport,
         "workers": workers,
@@ -248,6 +299,39 @@ def run_transport_bench(workers_list: Sequence[int] = (4, 16, 64),
             "N producer processes -> 1 consumer, per-worker channels both "
             "ways; timed window starts after every producer's first chunk "
             "(startup excluded); host-only (no jax in any process)"
+        ),
+    }
+
+
+def run_net_bench(workers_list: Sequence[int] = (4, 16, 64),
+                 seconds: float = 3.0, rows: int = 64,
+                 obs_shape=(84, 84, 1), ring_bytes: int = 4 << 20) -> dict:
+    """``xp_net``: shm ring vs TCP-loopback at each fleet width — what
+    leaving /dev/shm for a socket actually costs on one host (the
+    cross-host transport's upper bound: loopback pays the framing, crc,
+    kernel socket path and per-frame copies, but no wire latency)."""
+    points = []
+    for w in workers_list:
+        shm = run_transport_point("shm_ring", w, seconds, rows, obs_shape,
+                                  ring_bytes=ring_bytes)
+        tcp = run_transport_point("tcp_loopback", w, seconds, rows,
+                                  obs_shape, ring_bytes=ring_bytes)
+        base = max(tcp["transitions_per_sec"], 1e-9)
+        points.append({
+            "workers": w,
+            "shm_ring": shm,
+            "tcp_loopback": tcp,
+            "shm_over_tcp": round(shm["transitions_per_sec"] / base, 2),
+        })
+    return {
+        "points": points,
+        "chunk_transitions": rows,
+        "obs_shape": list(obs_shape),
+        "note": (
+            "N producer processes -> 1 consumer; identical CRC-framed "
+            "APXT records both ways (shm ring vs runtime/net.py TCP "
+            "frames over loopback); timed window starts after every "
+            "producer's first chunk; host-only (no jax in any process)"
         ),
     }
 
