@@ -280,6 +280,29 @@ class ServingConfig:
     # is adopted.  0 disables — a checkpoint-dir source with a legitimately
     # old final checkpoint should not degrade by default.
     param_stale_s: float = 0.0
+    # --- network transport (serving/net_server.py + serving/router.py) ---
+    # Bind host/port for the socket request/reply plane (serve --listen)
+    # and the replica router.  Port 0 = ephemeral (the bound port is
+    # announced as a `serving_listen` JSONL event — what the router and
+    # CI gates parse).  Loopback by default: a public front door is a
+    # deployment decision, not a config default.
+    listen_host: str = "127.0.0.1"
+    listen_port: int = 0
+    # Fleet width for `serve --replicas` (0 on the CLI = this default).
+    replicas: int = 2
+    # Length-prefix cap on the request plane: one absurd prefix must not
+    # make a replica buffer a GiB before the crc check would catch it
+    # (the transport's own sanity bound stays 1 GiB for param frames).
+    max_request_bytes: int = 8 << 20
+    # Router /healthz probe cadence; a 503/dead replica drains from
+    # rotation within one probe (or instantly on a failed connect).
+    probe_interval_s: float = 0.5
+    # How long the fleet waits for a replica subprocess to announce its
+    # ports (jax import + bucket warmup dominate on cold starts).
+    replica_spawn_timeout_s: float = 240.0
+    # Param-tail fallback (serving/sources.ParamTailWriter): full
+    # snapshot every N publishes, page-deltas between.
+    param_tail_base_every: int = 16
 
 
 @dataclasses.dataclass
@@ -500,6 +523,18 @@ class ApexConfig:
              "actor.respawn_min_interval_s must be >= 0"),
             (s.param_stale_s >= 0.0,
              "serving.param_stale_s must be >= 0"),
+            (0 <= s.listen_port <= 65535,
+             "serving.listen_port must be in [0, 65535]"),
+            (s.replicas >= 1, "serving.replicas must be >= 1"),
+            (s.max_request_bytes >= 1 << 16,
+             "serving.max_request_bytes must be >= 64 KiB (one batched "
+             "observation must fit a frame)"),
+            (s.probe_interval_s > 0.0,
+             "serving.probe_interval_s must be > 0"),
+            (s.replica_spawn_timeout_s > 0.0,
+             "serving.replica_spawn_timeout_s must be > 0"),
+            (s.param_tail_base_every >= 1,
+             "serving.param_tail_base_every must be >= 1"),
             *self.supervisor.validate_section(),
             *self.chaos.validate_section(),
             (a.mode != "process" or a.num_actors >= a.num_workers,

@@ -442,6 +442,10 @@ class AsyncPipeline:
 
         ocfg = self.cfg.obs
         self.obs_registry = MetricsRegistry()
+        # Host-process extensions (serve.py's attached serving tier, a
+        # mounted socket front end, ...) can ride the trainer's periodic
+        # JSONL emit as their own named section — register_jsonl_section.
+        self._jsonl_sections: dict = {}
         # Pipeline-overlap instruments (ISSUE 5): host_syncs counts every
         # BLOCKING device read on the learner thread (a free read of an
         # already-landed async copy is not a sync — no device idle, no
@@ -1376,6 +1380,22 @@ class AsyncPipeline:
                 pass
             self.obs_server = None
 
+    def register_jsonl_section(self, name: str, fn) -> None:
+        """Fold ``fn()`` into every periodic emit as section ``name`` —
+        how serve.py --attach rides a ``serving_net`` section on the
+        trainer's JSONL stream (docs/METRICS.md).  A section that raises
+        is dropped from that record, never the record itself."""
+        self._jsonl_sections[str(name)] = fn
+
+    def _sections_extra(self) -> dict:
+        out = {}
+        for name, fn in getattr(self, "_jsonl_sections", {}).items():
+            try:
+                out[name] = fn()
+            except Exception:  # noqa: BLE001 — a sick section must not
+                pass           # take the trainer's emit loop down
+        return out
+
     def _obs_extra(self) -> dict:
         """Per-worker shm stats + lineage on the SAME emit as learner
         throughput — the fleet-wide record the ISSUE's analysis needs in
@@ -1498,6 +1518,7 @@ class AsyncPipeline:
             **self._ckpt_extra(),
             **self._supervisor_extra(),
             **self._obs_extra(),
+            **self._sections_extra(),
         )
 
     def _place(self, host_batch):
@@ -1570,4 +1591,5 @@ class AsyncPipeline:
             **self._ckpt_extra(),
             **self._supervisor_extra(),
             **self._obs_extra(),
+            **self._sections_extra(),
         )

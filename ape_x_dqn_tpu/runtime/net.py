@@ -65,10 +65,24 @@ _NET_MAGIC = b"APXN"
 _NET_VERSION = 1
 _HELLO = struct.Struct("<4sIqqq")     # magic, version, worker_id, attempt, token
 _FRAME = struct.Struct("<IIqB7x")     # len, crc32, seq, kind (24 B, aligned)
+FRAME = _FRAME                        # public alias (serving plane, tools)
 
 F_XP = 1           # worker → learner: one experience record payload
 F_PARAM_FULL = 2   # learner → worker: i64 version | snapshot blob
 F_PARAM_DELTA = 3  # learner → worker: page-delta against the previous version
+
+# Serving request/reply kinds (serving/net_server.py) — the policy tier's
+# wire protocol rides the SAME frame header + crc/seq discipline, so one
+# parser and one adversarial-decode contract cover both planes.
+F_SREQ = 16        # client → server: one observation to act on
+F_SREP = 17        # server → client: greedy action + evidence
+F_SERR = 18        # server → client: typed refusal (shed / closed / bad)
+
+# F_SERR error codes.
+E_OVERLOADED = 1   # admission control shed the request (retry later)
+E_CLOSED = 2       # server shutting down
+E_BAD_REQUEST = 3  # well-framed but undecodable/ill-shaped request
+E_INTERNAL = 4     # batch raised; the exception type rides the message
 
 _CRC_WINDOW = 4096          # shm_ring's sampled-crc coverage, mirrored
 _MAX_FRAME = 1 << 30        # sanity bound on the length prefix
@@ -80,6 +94,20 @@ _PDELTA = struct.Struct("<qqIIII")        # version, base, full_crc,
 _PIDX = struct.Struct("<I")
 
 _SEND_SLICE = 1 << 18
+
+# Serving hello: clients are anonymous (no run token — the serving port is
+# a public-ish front door, not the fleet's private experience plane), but
+# the magic/version still reject port confusion before any framing state.
+SERVE_MAGIC = b"APXQ"
+SERVE_VERSION = 1
+SERVE_HELLO = struct.Struct("<4sI")
+# Request: u64 req_id | u8 ndim | u8 dtype (0=uint8) | 6x pad | u32 dims…
+_SREQ_HEAD = struct.Struct("<QBB6x")
+_SREQ_DIM = struct.Struct("<I")
+# Reply: u64 req_id | i32 action | i64 param_version | u32 num_q | f32 q…
+_SREP_HEAD = struct.Struct("<QiqI4x")
+# Error: u64 req_id | u16 code | utf-8 message
+_SERR_HEAD = struct.Struct("<QH6x")
 
 
 def _as_bytes(part) -> bytes:
@@ -113,6 +141,97 @@ def frame_bytes(kind: int, seq: int, parts: Sequence,
     return _FRAME.pack(n, _crc_payload(payload, crc_full), seq, kind) + payload
 
 
+def serve_hello_bytes() -> bytes:
+    return SERVE_HELLO.pack(SERVE_MAGIC, SERVE_VERSION)
+
+
+def parse_serve_hello(buf: bytes) -> bool:
+    """True iff ``buf`` is a valid serving-protocol hello."""
+    if len(buf) != SERVE_HELLO.size:
+        return False
+    try:
+        magic, version = SERVE_HELLO.unpack(buf)
+    except struct.error:
+        return False
+    return magic == SERVE_MAGIC and version == SERVE_VERSION
+
+
+def encode_request(req_id: int, obs) -> bytes:
+    """One F_SREQ payload: id + shape manifest + raw uint8 observation
+    bytes (the APXT discipline in miniature — nothing executable)."""
+    import numpy as np
+
+    arr = np.ascontiguousarray(obs, dtype=np.uint8)
+    if arr.ndim > 8:
+        raise ValueError(f"observation rank {arr.ndim} > 8")
+    return b"".join(
+        [_SREQ_HEAD.pack(int(req_id), arr.ndim, 0),
+         *(_SREQ_DIM.pack(d) for d in arr.shape),
+         arr.tobytes()]
+    )
+
+
+def decode_request(payload: bytes):
+    """(req_id, uint8 obs array) from one verified F_SREQ payload.
+    Raises ValueError on a shape manifest that does not match the byte
+    count — a well-framed-but-ill-formed request (E_BAD_REQUEST), NOT a
+    torn frame (the crc already verified these bytes arrived intact)."""
+    import numpy as np
+
+    if len(payload) < _SREQ_HEAD.size:
+        raise ValueError("request shorter than its header")
+    req_id, ndim, dtype_code = _SREQ_HEAD.unpack_from(payload, 0)
+    if dtype_code != 0:
+        raise ValueError(f"unknown request dtype code {dtype_code}")
+    if ndim > 8:
+        raise ValueError(f"observation rank {ndim} > 8")
+    off = _SREQ_HEAD.size
+    if len(payload) < off + ndim * _SREQ_DIM.size:
+        raise ValueError("request truncated inside its shape manifest")
+    shape = tuple(
+        _SREQ_DIM.unpack_from(payload, off + k * _SREQ_DIM.size)[0]
+        for k in range(ndim)
+    )
+    off += ndim * _SREQ_DIM.size
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if len(payload) - off != n:
+        raise ValueError(
+            f"request body {len(payload) - off} B != shape {shape} ({n} B)"
+        )
+    arr = np.frombuffer(payload, np.uint8, n, off).reshape(shape)
+    return int(req_id), arr.copy()  # own the memory past the recv buffer
+
+
+def encode_reply(req_id: int, action: int, param_version: int,
+                 q_values) -> bytes:
+    import numpy as np
+
+    q = np.ascontiguousarray(q_values, dtype=np.float32).reshape(-1)
+    return _SREP_HEAD.pack(int(req_id), int(action), int(param_version),
+                           q.size) + q.tobytes()
+
+
+def decode_reply(payload: bytes):
+    """(req_id, action, param_version, float32 q_values)."""
+    import numpy as np
+
+    req_id, action, version, num_q = _SREP_HEAD.unpack_from(payload, 0)
+    q = np.frombuffer(payload, np.float32, num_q, _SREP_HEAD.size)
+    return int(req_id), int(action), int(version), q.copy()
+
+
+def encode_error(req_id: int, code: int, message: str = "") -> bytes:
+    return _SERR_HEAD.pack(int(req_id), int(code)) + message.encode()[:512]
+
+
+def decode_error(payload: bytes):
+    """(req_id, code, message)."""
+    req_id, code = _SERR_HEAD.unpack_from(payload, 0)
+    return int(req_id), int(code), payload[_SERR_HEAD.size:].decode(
+        errors="replace"
+    )
+
+
 class FrameParser:
     """Incremental decoder of one connection's framed byte stream.
 
@@ -121,11 +240,18 @@ class FrameParser:
     the caller counts a torn frame and retires the connection (the
     stream-level analogue of a torn ring tail: detected, never
     delivered).
+
+    ``max_frame`` tightens the length-prefix sanity bound below the
+    module default — the serving plane caps requests at
+    ``serving.max_request_bytes`` so one absurd prefix cannot make the
+    server buffer a GiB before the crc check would catch it.
     """
 
-    def __init__(self, crc_full: bool = False):
+    def __init__(self, crc_full: bool = False,
+                 max_frame: int = _MAX_FRAME):
         self._buf = bytearray()
         self._crc_full = bool(crc_full)
+        self._max_frame = int(max_frame)
         self.seq = 0          # last accepted seq
         self.frames = 0
         self.bytes = 0        # raw bytes fed
@@ -147,7 +273,7 @@ class FrameParser:
         if len(self._buf) < _FRAME.size:
             return None
         length, crc, seq, kind = _FRAME.unpack_from(self._buf, 0)
-        if length > _MAX_FRAME:
+        if length > self._max_frame:
             self.error = "length"
             return None
         if len(self._buf) < _FRAME.size + length:

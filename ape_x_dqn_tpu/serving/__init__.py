@@ -1,19 +1,34 @@
-"""Policy serving: batched Q-network inference with hot param reload.
+"""Policy serving: batched Q-network inference, hot reload, and a fleet.
 
 The training half of Ape-X broadcasts learner params to actor fleets
 (runtime/param_store.py) that amortize one jitted forward over a whole
 fleet (actors/pool.py).  This package mounts the *inference* half on the
-same two seams: a dynamic micro-batcher coalesces concurrent client
-requests into fixed-bucket batches for one jitted ``argmax Q(s,.)`` call,
-and a reload thread polls any ``ParamSource`` — a live trainer's
-``ParamStore`` or a checkpoint dir — swapping params atomically between
-batches, so a training run and a serving tier share one process with zero
-dropped requests on update.
+same seams, now network-native end to end:
+
+  * a dynamic micro-batcher coalesces concurrent client requests into
+    fixed-bucket batches for one jitted ``argmax Q(s,.)`` call
+    (serving/batcher.py);
+  * a reload thread polls any ``ParamSource`` — a live trainer's
+    ``ParamStore``, a checkpoint dir, a socket param hub, or an APXC
+    delta-chunk tail — swapping params atomically between batches
+    (serving/server.py, serving/sources.py);
+  * a socket front end speaks the length-prefixed CRC-framed
+    request/reply protocol into the same batcher
+    (serving/net_server.py);
+  * a health-aware router balances client connections over N replica
+    subprocesses, with learner params fanned out to the whole fleet as
+    page-deltas over the runtime/net transport (serving/router.py).
 
 Public surface:
   * :class:`PolicyServer` — submit/act + hot reload + serving metrics;
   * :class:`MicroBatcher` — the bucket-padding deadline batcher;
-  * :class:`CheckpointParamSource` — ParamSource over a checkpoint dir;
+  * :class:`ServingNetServer` / :class:`ServingClient` — the socket
+    request/reply plane;
+  * :class:`ServingRouter` / :class:`ServingFleet` /
+    :class:`ReplicaProcess` — N replicas behind one front door;
+  * ParamSources: :class:`CheckpointParamSource`,
+    :class:`SocketParamSource`, :class:`ParamTailSource`
+    (+ :class:`ParamTailWriter`);
   * typed admission errors: :class:`ServerOverloaded`, :class:`ServerClosed`.
 """
 
@@ -26,17 +41,38 @@ from ape_x_dqn_tpu.serving.batcher import (
     bucket_for,
     bucket_sizes,
 )
+from ape_x_dqn_tpu.serving.net_server import ServingClient, ServingNetServer
+from ape_x_dqn_tpu.serving.router import (
+    ReplicaProcess,
+    ServingFleet,
+    ServingRouter,
+)
 from ape_x_dqn_tpu.serving.server import PolicyServer
-from ape_x_dqn_tpu.serving.sources import CheckpointParamSource
+from ape_x_dqn_tpu.serving.sources import (
+    CheckpointParamSource,
+    ParamTailSource,
+    ParamTailWriter,
+    SocketParamSource,
+    parse_hub_spec,
+)
 
 __all__ = [
     "CheckpointParamSource",
     "MicroBatcher",
+    "ParamTailSource",
+    "ParamTailWriter",
     "PolicyServer",
+    "ReplicaProcess",
     "ServedAction",
     "ServerClosed",
     "ServerOverloaded",
+    "ServingClient",
     "ServingError",
+    "ServingFleet",
+    "ServingNetServer",
+    "ServingRouter",
+    "SocketParamSource",
     "bucket_for",
     "bucket_sizes",
+    "parse_hub_spec",
 ]

@@ -1,0 +1,555 @@
+"""Socket front end for the policy tier: framed request/reply serving.
+
+PolicyServer (serving/server.py) batches beautifully but only speaks
+in-process Python — one replica, one host, no fleet.  This module puts a
+real transport in front of the SAME micro-batcher: a nonblocking
+acceptor/pump thread speaks the length-prefixed binary protocol from
+runtime/net.py (``u32 len | u32 crc | i64 seq | u8 kind`` — the exact
+framing discipline the experience plane proved under the adversarial
+decode matrix) and feeds every verified request straight into
+``PolicyServer.submit``.  The reply rides back on the batcher thread's
+future callback, so the select loop never blocks on compute and the
+batcher never blocks on a slow client (per-connection outboxes, flushed
+as sockets drain).
+
+Contracts, mirrored from the experience transport:
+
+  * **Torn frames are counted, never decoded.**  Any framing fault —
+    truncation mid-prefix or mid-payload, a crc bitflip, a seq skip, an
+    oversize length prefix (bounded by ``serving.max_request_bytes``,
+    far below the transport's GiB sanity cap) — retires the CONNECTION;
+    nothing from the bad stream reaches the batcher.
+  * **Typed refusals, not silent drops.**  Admission-control shed
+    (``ServerOverloaded``) and shutdown (``ServerClosed``) go back as
+    ``F_SERR`` frames with typed codes, so a closed-loop client can
+    distinguish "retry later" from "gone" from "my bug".
+  * **Every reply carries ``param_version``** (the batcher snapshots
+    params once per batch), so a fleet-wide hot reload is observable
+    per-reply from the client side.
+  * **Per-request latency** (request decoded → reply enqueued) on the
+    existing ``utils.metrics.LatencyHistogram`` — p50/p95/p99 on the
+    same instrument the in-process path reports.
+
+``ServingClient`` is the reference client: blocking closed-loop calls
+with reconnect-with-backoff (runtime/net.Backoff) and whole-request
+retry, so a replica dying mid-flight costs the client a reconnect, not
+an answer — the zero-drop arithmetic the router smoke pins.
+"""
+
+from __future__ import annotations
+
+import collections
+import select
+import socket
+import threading
+import time
+from typing import Optional
+
+from ape_x_dqn_tpu.runtime.net import (
+    E_BAD_REQUEST,
+    E_CLOSED,
+    E_INTERNAL,
+    E_OVERLOADED,
+    F_SERR,
+    F_SREP,
+    F_SREQ,
+    Backoff,
+    FrameParser,
+    decode_error,
+    decode_reply,
+    decode_request,
+    encode_error,
+    encode_reply,
+    encode_request,
+    frame_bytes,
+    parse_serve_hello,
+    serve_hello_bytes,
+)
+from ape_x_dqn_tpu.serving.batcher import (
+    ServedAction,
+    ServerClosed,
+    ServerOverloaded,
+    ServingError,
+)
+from ape_x_dqn_tpu.utils.metrics import LatencyHistogram
+
+_RECV_CHUNK = 1 << 16
+_HELLO_SIZE = len(serve_hello_bytes())
+
+
+class _NetConn:
+    """One client connection's state, owned by the pump thread (outbox
+    appends come from batcher callbacks under the server lock)."""
+
+    __slots__ = ("sock", "parser", "hello", "outbox", "out_off", "out_seq",
+                 "bytes_in", "bytes_out", "inflight")
+
+    def __init__(self, sock: socket.socket, max_frame: int):
+        self.sock = sock
+        self.parser = FrameParser(max_frame=max_frame)
+        self.hello = bytearray()          # hello bytes gathered so far
+        self.outbox: collections.deque = collections.deque()
+        self.out_off = 0                  # send offset into outbox[0]
+        self.out_seq = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.inflight = 0                 # submitted, reply not yet queued
+
+
+class ServingNetServer:
+    """Multi-client socket acceptor over one PolicyServer.
+
+    One daemon thread runs accept + recv + parse + submit + flush in a
+    select loop; batcher-thread future callbacks enqueue replies and wake
+    it through a socketpair.  ``stats()`` is the ``serving_net`` JSONL /
+    /varz section (docs/METRICS.md, pinned by TestMetricsDocSchema).
+    """
+
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0, *,
+                 max_request_bytes: int = 8 << 20,
+                 name: str = "serving-net"):
+        self._server = server
+        self._max_frame = int(max_request_bytes)
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, int(port)))
+        self._lsock.listen(256)
+        self._lsock.setblocking(False)
+        self.host = host
+        self.port = self._lsock.getsockname()[1]
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._lock = threading.Lock()     # conn registry + outboxes
+        self._conns: dict = {}            # fileno -> _NetConn
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._started = False
+        # Counters (the serving_net schema).
+        self.latency = LatencyHistogram()
+        self.accepted = 0
+        self.requests = 0
+        self.replies = 0
+        self.shed = 0
+        self.errors = 0          # bad requests + batch exceptions replied
+        self.torn_frames = 0
+        self.bad_hellos = 0
+        self.orphaned = 0        # replies whose connection was already gone
+        # Retired-connection byte history (a reconnecting client must not
+        # take its traffic with it — the NetTransport._base discipline).
+        self._bytes_in_closed = 0
+        self._bytes_out_closed = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServingNetServer":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake()
+        if self._started:
+            self._thread.join(timeout=5.0)
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        self._wake_r.close()
+        self._wake_w.close()
+
+    def __enter__(self) -> "ServingNetServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\x00")
+        except OSError:
+            pass
+
+    # -- pump thread -------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                socks = {c.sock: c for c in self._conns.values()}
+                wlist = [c.sock for c in self._conns.values() if c.outbox]
+            rlist = [self._lsock, self._wake_r, *socks]
+            try:
+                r, w, _ = select.select(rlist, wlist, [], 0.25)
+            except (OSError, ValueError):
+                # A socket closed under us mid-select: rebuild the sets.
+                time.sleep(0.005)
+                continue
+            if self._wake_r in r:
+                try:
+                    while self._wake_r.recv(4096):
+                        pass
+                except OSError:
+                    pass
+            if self._lsock in r:
+                self._accept_pending()
+            for sock in w:
+                conn = socks.get(sock)
+                if conn is not None:
+                    self._flush(conn)
+            for sock in r:
+                conn = socks.get(sock)
+                if conn is not None:
+                    self._on_readable(conn)
+
+    def _accept_pending(self) -> None:
+        while True:
+            try:
+                sock, _addr = self._lsock.accept()
+            except (BlockingIOError, InterruptedError, OSError):
+                return
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            self.accepted += 1
+            with self._lock:
+                self._conns[sock.fileno()] = _NetConn(sock, self._max_frame)
+
+    def _retire(self, conn: _NetConn, torn: bool = False) -> None:
+        """Close one connection; a partial frame left in its parser (or a
+        parser fault) counts torn — detected, never delivered."""
+        if torn or conn.parser.pending() or conn.parser.error is not None:
+            self.torn_frames += 1
+        with self._lock:
+            self._conns.pop(conn.sock.fileno(), None)
+            self._bytes_in_closed += conn.bytes_in
+            self._bytes_out_closed += conn.bytes_out
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _on_readable(self, conn: _NetConn) -> None:
+        while True:
+            try:
+                data = conn.sock.recv(_RECV_CHUNK)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._retire(conn)
+                return
+            if not data:
+                self._retire(conn)
+                return
+            conn.bytes_in += len(data)
+            if len(conn.hello) < _HELLO_SIZE:
+                need = _HELLO_SIZE - len(conn.hello)
+                conn.hello += data[:need]
+                data = data[need:]
+                if len(conn.hello) == _HELLO_SIZE and not parse_serve_hello(
+                    bytes(conn.hello)
+                ):
+                    self.bad_hellos += 1
+                    self._retire(conn)
+                    return
+                if not data:
+                    continue
+            conn.parser.feed(data)
+        self._drain_frames(conn)
+
+    def _drain_frames(self, conn: _NetConn) -> None:
+        while True:
+            got = conn.parser.next()
+            if got is None:
+                if conn.parser.error is not None:
+                    self._retire(conn, torn=True)
+                return
+            kind, payload = got
+            if kind != F_SREQ:
+                # Protocol violation (reply kinds only flow server→client):
+                # stream corruption, connection-level recovery.
+                self._retire(conn, torn=True)
+                return
+            self._handle_request(conn, payload)
+
+    def _handle_request(self, conn: _NetConn, payload: bytes) -> None:
+        t0 = time.monotonic()
+        try:
+            req_id, obs = decode_request(payload)
+        except ValueError as e:
+            self.errors += 1
+            self._enqueue(conn, F_SERR, encode_error(0, E_BAD_REQUEST,
+                                                     str(e)))
+            return
+        self.requests += 1
+        try:
+            fut = self._server.submit(obs)
+        except ServerOverloaded as e:
+            self.shed += 1
+            self._enqueue(conn, F_SERR,
+                          encode_error(req_id, E_OVERLOADED, str(e)))
+            return
+        except ServerClosed as e:
+            self._enqueue(conn, F_SERR, encode_error(req_id, E_CLOSED,
+                                                     str(e)))
+            return
+        conn.inflight += 1
+        fut.add_done_callback(
+            lambda f, c=conn, rid=req_id, t=t0: self._complete(c, rid, t, f)
+        )
+
+    def _complete(self, conn: _NetConn, req_id: int, t0: float,
+                  fut) -> None:
+        """Batcher-thread callback: encode the reply and queue it on the
+        connection's outbox (or count it orphaned if the client is gone —
+        it has already reconnected and retried elsewhere)."""
+        exc = fut.exception()
+        if exc is None:
+            res: ServedAction = fut.result()
+            body = encode_reply(req_id, res.action, res.param_version,
+                                res.q_values)
+            kind = F_SREP
+        elif isinstance(exc, ServerClosed):
+            body, kind = encode_error(req_id, E_CLOSED, str(exc)), F_SERR
+        else:
+            self.errors += 1
+            body = encode_error(req_id, E_INTERNAL,
+                                f"{type(exc).__name__}: {exc}")
+            kind = F_SERR
+        conn.inflight -= 1
+        if not self._enqueue(conn, kind, body):
+            self.orphaned += 1
+            return
+        if exc is None:
+            self.replies += 1
+            self.latency.record(time.monotonic() - t0)
+
+    def _enqueue(self, conn: _NetConn, kind: int, body: bytes) -> bool:
+        """Queue one outbound frame; False if the connection is gone.
+        Seq is assigned under the lock, so outbox order == seq order even
+        with the batcher and pump threads both replying."""
+        with self._lock:
+            if self._conns.get(conn.sock.fileno()) is not conn:
+                return False
+            conn.out_seq += 1
+            conn.outbox.append(frame_bytes(kind, conn.out_seq, [body]))
+        self._wake()
+        return True
+
+    def _flush(self, conn: _NetConn) -> None:
+        while True:
+            with self._lock:
+                if not conn.outbox:
+                    return
+                buf = conn.outbox[0]
+            try:
+                n = conn.sock.send(memoryview(buf)[conn.out_off:])
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._retire(conn)
+                return
+            conn.bytes_out += n
+            conn.out_off += n
+            if conn.out_off >= len(buf):
+                conn.out_off = 0
+                with self._lock:
+                    if conn.outbox:
+                        conn.outbox.popleft()
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``serving_net`` section (docs/METRICS.md "Serving net
+        schema" — key set pinned by tests/test_obs.py)."""
+        with self._lock:
+            conns = list(self._conns.values())
+        return {
+            "port": self.port,
+            "connections": len(conns),
+            "accepted": self.accepted,
+            "requests": self.requests,
+            "replies": self.replies,
+            "shed": self.shed,
+            "errors": self.errors,
+            "torn_frames": self.torn_frames,
+            "bad_hellos": self.bad_hellos,
+            "orphaned": self.orphaned,
+            "inflight": sum(c.inflight for c in conns),
+            "bytes_in": sum(c.bytes_in for c in conns)
+            + self._bytes_in_closed,
+            "bytes_out": sum(c.bytes_out for c in conns)
+            + self._bytes_out_closed,
+            "param_version": int(getattr(self._server, "param_version", -1)),
+            "latency": self.latency.summary(),
+        }
+
+
+class ServingClient:
+    """Blocking closed-loop client with reconnect + whole-request retry.
+
+    ``act`` sends one observation and waits for ITS reply; any transport
+    fault — connect refused, reset mid-flight, torn stream — drops the
+    connection, backs off (jittered exponential), reconnects and resends
+    the request whole.  A request is only lost when the deadline expires
+    (``TimeoutError``), so "zero drops" is measurable client-side:
+    every ``act`` call either returns, raises typed, or times out.
+    """
+
+    def __init__(self, host: str, port: int, *,
+                 connect_timeout_s: float = 2.0,
+                 io_timeout_s: float = 5.0, seed: int = 0,
+                 max_frame: int = 64 << 20):
+        self.host = host
+        self.port = int(port)
+        self._connect_timeout = float(connect_timeout_s)
+        self._io_timeout = float(io_timeout_s)
+        self._max_frame = int(max_frame)
+        self._sock: Optional[socket.socket] = None
+        self._parser = FrameParser(max_frame=max_frame)
+        self._backoff = Backoff(base_s=0.05, max_s=1.0, seed=seed)
+        self._req_id = 0
+        self._out_seq = 0
+        self.reconnects = 0
+        self.retries = 0
+        self.shed_seen = 0
+        self._ever_connected = False
+
+    # -- connection --------------------------------------------------------
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _ensure_connected(self) -> bool:
+        if self._sock is not None:
+            return True
+        if not self._backoff.ready():
+            return False
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=self._connect_timeout
+            )
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.sendall(serve_hello_bytes())
+            sock.settimeout(self._io_timeout)
+        except OSError:
+            self._backoff.fail()
+            return False
+        self._sock = sock
+        self._parser = FrameParser(max_frame=self._max_frame)
+        self._out_seq = 0
+        # NB: backoff resets on a verified REPLY (act), not here — a
+        # router with zero healthy replicas accepts and closes instantly,
+        # and resetting on connect would turn that into a tight loop.
+        self.reconnects += int(self._ever_connected)
+        self._ever_connected = True
+        return True
+
+    # -- request path ------------------------------------------------------
+
+    def act(self, obs, timeout: float = 30.0) -> ServedAction:
+        """One observation → one ServedAction, across reconnects.
+
+        Raises :class:`ServerOverloaded` on a typed shed reply (counted
+        on ``shed_seen`` — the caller decides whether to retry),
+        :class:`ServingError` on other typed refusals, and
+        ``TimeoutError`` when the deadline expires unanswered."""
+        t_start = time.monotonic()
+        deadline = t_start + timeout
+        first_try = True
+        while time.monotonic() < deadline:
+            if not self._ensure_connected():
+                time.sleep(0.005)
+                continue
+            if not first_try:
+                self.retries += 1
+            first_try = False
+            self._req_id += 1
+            rid = self._req_id
+            try:
+                self._out_seq += 1
+                self._sock.sendall(
+                    frame_bytes(F_SREQ, self._out_seq,
+                                [encode_request(rid, obs)])
+                )
+                got = self._await_reply(rid, deadline)
+            except (OSError, socket.timeout):
+                self._drop()
+                self._backoff.fail()
+                continue
+            if got is None:          # torn stream / stale reply: retry
+                continue
+            kind, payload = got
+            if kind == F_SREP:
+                self._backoff.reset()
+                req_id, action, version, q = decode_reply(payload)
+                return ServedAction(action, q, version,
+                                    time.monotonic() - t_start)
+            req_id, code, msg = decode_error(payload)
+            if code == E_OVERLOADED:
+                self._backoff.reset()   # transport fine; server is shedding
+                self.shed_seen += 1
+                raise ServerOverloaded(msg)
+            if code == E_CLOSED:
+                # Replica draining/shutting down: reconnect (the router
+                # re-balances to a live one) rather than failing the call.
+                self._drop()
+                self._backoff.fail()
+                continue
+            raise ServingError(f"server error {code}: {msg}")
+        raise TimeoutError(
+            f"no reply within {timeout:.1f}s "
+            f"(retries={self.retries}, reconnects={self.reconnects})"
+        )
+
+    def _await_reply(self, rid: int, deadline: float):
+        """Frames until ``rid``'s reply (or None to force a retry after a
+        dropped connection / torn stream)."""
+        while True:
+            got = self._parser.next()
+            if got is not None:
+                kind, payload = got
+                if kind == F_SREP:
+                    if decode_reply(payload)[0] == rid:
+                        return kind, payload
+                    continue              # stale reply from a retried req
+                if kind == F_SERR:
+                    req_id = decode_error(payload)[0]
+                    if req_id in (rid, 0):
+                        return kind, payload
+                    continue
+                # Unknown kind: protocol violation — treat as torn.
+                self._drop()
+                self._backoff.fail()
+                return None
+            if self._parser.error is not None:
+                self._drop()
+                self._backoff.fail()
+                return None
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("deadline")
+            self._sock.settimeout(min(self._io_timeout, remaining))
+            data = self._sock.recv(_RECV_CHUNK)
+            if not data:
+                raise OSError("connection closed by peer")
+            self._parser.feed(data)
+
+    def close(self) -> None:
+        self._drop()

@@ -190,6 +190,19 @@ class PolicyServer:
     # -- observability ----------------------------------------------------
 
     @property
+    def batcher(self) -> "MicroBatcher":
+        """The micro-batcher behind this server — the seam the socket
+        front end (serving/net_server.py) and the /healthz heartbeat
+        registration both mount."""
+        return self._batcher
+
+    def attach_transport(self, stats_fn) -> None:
+        """Fold a transport's stats into ``stats()`` under ``net`` —
+        one snapshot covers the in-process batcher AND its socket front
+        end once a ServingNetServer is mounted."""
+        self._transport_stats = stats_fn
+
+    @property
     def param_version(self) -> int:
         return self._live[1]
 
@@ -222,6 +235,8 @@ class PolicyServer:
             out["versions_behind"] = max(
                 0, int(self._source.version) - version
             )
+        if getattr(self, "_transport_stats", None) is not None:
+            out["net"] = self._transport_stats()
         return out
 
     def emit_metrics(self, logger, **extra) -> dict:

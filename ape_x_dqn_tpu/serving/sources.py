@@ -1,15 +1,34 @@
-"""ParamSources beyond the live ParamStore: serve from checkpoints on disk.
+"""ParamSources beyond the live ParamStore: checkpoints, sockets, tails.
 
 The serving tier mounts the same ``get(have_version) -> (params, version)``
 protocol the actor fleets poll (actors/pool.py), so "attach to a live
-trainer" and "watch a checkpoint dir" are the same server wiring with a
-different source plugged in.  Here: the checkpoint-dir source, keyed on
-``utils/checkpoint.latest_step`` — orbax commits atomically (tmp dir +
-rename), so a half-written checkpoint is never visible as a new version.
+trainer", "watch a checkpoint dir", "subscribe to a param hub over a
+socket" and "tail a delta-chunk file chain" are the same server wiring
+with a different source plugged in.  Sources here:
+
+  * :class:`CheckpointParamSource` — checkpoint root dir, keyed on
+    ``utils/checkpoint.latest_step`` (orbax commits atomically, so a
+    half-written checkpoint is never visible as a new version).
+  * :class:`SocketParamSource` — a replica's subscription to the fleet's
+    param hub (serving/router.ServingFleet): the runtime/net
+    ``NetWriter`` + ``NetParamSource`` pair — delta-or-full framed
+    messages, crc-verified patches, reconnect-with-backoff — pointed at
+    the serving plane.  A hot reload reaches the replica in delta-sized
+    bytes without it ever touching a checkpoint dir.
+  * :class:`ParamTailSource` (+ :class:`ParamTailWriter`) — the
+    checkpoint-attached fallback: the SAME delta-or-full payloads as
+    the socket codec, committed as CRC-framed APXC chunk files
+    (``utils/checkpoint_inc.write_chunk`` — tmp+fsync+rename, torn
+    files typed `ChunkCorrupt`, never decoded).  Replicas on a shared
+    filesystem tail delta-sized files instead of re-reading full
+    checkpoints; a corrupt rung walks back to the newest intact full,
+    mirroring the replay chain's fallback-restore discipline.
 """
 
 from __future__ import annotations
 
+import os
+import re
 from typing import Any, Optional, Tuple
 
 from ape_x_dqn_tpu.utils.checkpoint import latest_step, restore_checkpoint
@@ -47,3 +66,244 @@ class CheckpointParamSource:
         # just means we come back one version fresher than probed.
         state, restored_step = restore_checkpoint(self.root, self._template)
         return jax.device_get(state.params), int(restored_step)
+
+
+def parse_hub_spec(spec: str) -> dict:
+    """``host:port:token:wid:attempt`` → a runtime/net.NetWriter spec
+    (the string a ServingFleet hands each replica on its command line)."""
+    parts = spec.rsplit(":", 4)
+    if len(parts) != 5:
+        raise ValueError(
+            f"param hub spec {spec!r} is not host:port:token:wid:attempt"
+        )
+    host, port, token, wid, attempt = parts
+    return {"host": host, "port": int(port), "token": int(token),
+            "wid": int(wid), "attempt": int(attempt)}
+
+
+class SocketParamSource:
+    """Replica-side ParamSource over a fleet param-hub connection.
+
+    Wraps the worker fleet's exact machinery (runtime/net.NetWriter's
+    param pump + runtime/transport.NetParamSource's template restore):
+    full snapshot on connect, page-deltas against the held version after,
+    crc-verified patch, connection-drop + reconnect + full resync on any
+    fault.  The replica never touches a checkpoint dir.
+    """
+
+    def __init__(self, spec, template):
+        from ape_x_dqn_tpu.runtime.net import NetWriter
+        from ape_x_dqn_tpu.runtime.transport import NetParamSource
+
+        if isinstance(spec, str):
+            spec = parse_hub_spec(spec)
+        self._writer = NetWriter(spec)
+        self._inner = NetParamSource(self._writer, template)
+
+    @property
+    def version(self) -> int:
+        """Newest version received (-1 before the first full sync) —
+        powers the server's ``versions_behind``."""
+        return int(self._writer._param_version)
+
+    @property
+    def connected(self) -> bool:
+        return self._writer._sock is not None
+
+    def get(self, have_version: int = -1):
+        return self._inner.get(have_version)
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+_TAIL_RE = re.compile(r"^pp_(\d{10})_(full|delta)\.apxc$")
+
+
+def _tail_name(version: int, kind: str) -> str:
+    return f"pp_{int(version):010d}_{kind}.apxc"
+
+
+class ParamTailWriter:
+    """Publish params as a delta chain of APXC chunk files.
+
+    Every ``base_every`` publishes (or whenever a delta is impossible /
+    not worth it) a full snapshot lands; in between, page-deltas against
+    the previous version — the runtime/net codec's exact payloads,
+    committed through ``utils/checkpoint_inc.write_chunk`` so a torn
+    write is typed, never decoded.  Pruning keeps the current full's
+    chain plus the previous full's (the replay-chain retention rule):
+    a tail reader mid-walk never has its rung deleted out from under it.
+    """
+
+    def __init__(self, root: str, *, base_every: int = 16):
+        if base_every < 1:
+            raise ValueError("base_every must be >= 1")
+        self.root = root
+        self._base_every = int(base_every)
+        os.makedirs(root, exist_ok=True)
+        self._prev_payload: Optional[bytes] = None
+        self._version = 0
+        self._last_full = 0
+        self._prev_full = 0
+        self.full_writes = 0
+        self.delta_writes = 0
+        self.bytes_written = 0
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def publish_payload(self, payload: bytes) -> str:
+        """Commit one serialized snapshot; returns the path written."""
+        from ape_x_dqn_tpu.runtime.net import build_param_delta
+        from ape_x_dqn_tpu.utils.checkpoint_inc import write_chunk
+
+        import numpy as np
+
+        self._version += 1
+        v = self._version
+        delta = None
+        if self._prev_payload is not None \
+                and (v - self._last_full) < self._base_every:
+            delta = build_param_delta(v, v - 1, self._prev_payload, payload)
+        if delta is None:
+            kind, body, base = "full", payload, -1
+            self._prev_full, self._last_full = self._last_full, v
+            self.full_writes += 1
+        else:
+            kind, body, base = "delta", delta, v - 1
+            self.delta_writes += 1
+        path = os.path.join(self.root, _tail_name(v, kind))
+        self.bytes_written += write_chunk(path, {
+            "version": np.int64(v),
+            "base": np.int64(base),
+            "payload": np.frombuffer(body, dtype=np.uint8),
+        })
+        self._prev_payload = payload
+        self._prune()
+        return path
+
+    def publish(self, params) -> str:
+        import jax
+
+        from ape_x_dqn_tpu.utils.serialization import tree_to_bytes
+
+        return self.publish_payload(tree_to_bytes(jax.device_get(params)))
+
+    def _prune(self) -> None:
+        """Drop files older than the previous full's chain."""
+        floor = self._prev_full if self._prev_full > 0 else self._last_full
+        for name in os.listdir(self.root):
+            m = _TAIL_RE.match(name)
+            if m and int(m.group(1)) < floor:
+                try:
+                    os.unlink(os.path.join(self.root, name))
+                except OSError:
+                    pass
+
+
+class ParamTailSource:
+    """ParamSource tailing a :class:`ParamTailWriter` chain.
+
+    ``get`` walks to the newest reachable version: the held payload plus
+    any consecutive deltas, else the newest intact full plus its deltas.
+    Any rung failing CRC/decode (typed ``ChunkCorrupt`` from read_chunk,
+    or a delta whose base/crc mismatches) stops that chain and the walk
+    falls back to an older full — corrupt bytes never restore, the
+    fallback is silent-but-counted (``corrupt_skips``).
+    """
+
+    def __init__(self, root: str, template):
+        self.root = root
+        self._template = template
+        self._payload: Optional[bytes] = None
+        self._version = -1
+        self.corrupt_skips = 0
+
+    def _scan(self):
+        """Sorted [(version, kind, path)] of intact-named chain files."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            m = _TAIL_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), m.group(2),
+                            os.path.join(self.root, name)))
+        out.sort()
+        return out
+
+    @property
+    def version(self) -> int:
+        entries = self._scan()
+        return entries[-1][0] if entries else -1
+
+    def _read(self, path: str) -> Tuple[int, int, bytes]:
+        from ape_x_dqn_tpu.utils.checkpoint_inc import read_chunk
+
+        arrays = read_chunk(path)
+        return (int(arrays["version"]), int(arrays["base"]),
+                arrays["payload"].tobytes())
+
+    def _apply_deltas(self, payload: bytes, version: int,
+                      entries) -> Tuple[bytes, int]:
+        """Consecutive delta rungs from ``version``+1 upward; stops at a
+        gap, a full, or a corrupt/mismatched rung."""
+        from ape_x_dqn_tpu.runtime.net import apply_param_delta
+        from ape_x_dqn_tpu.utils.checkpoint_inc import ChunkCorrupt
+
+        by_version = {v: (kind, path) for v, kind, path in entries}
+        while True:
+            nxt = by_version.get(version + 1)
+            if nxt is None or nxt[0] != "delta":
+                return payload, version
+            try:
+                v, base, body = self._read(nxt[1])
+                if base != version:
+                    raise ValueError(
+                        f"delta base {base} != held version {version}"
+                    )
+                _, _, payload = apply_param_delta(payload, body)
+            except (ChunkCorrupt, ValueError):
+                self.corrupt_skips += 1
+                return payload, version
+            version = v
+
+    def get(self, have_version: int = -1):
+        from ape_x_dqn_tpu.utils.checkpoint_inc import ChunkCorrupt
+        from ape_x_dqn_tpu.utils.serialization import restore_like
+
+        entries = self._scan()
+        if not entries:
+            return None
+        # Fast path: extend the held payload by consecutive deltas.
+        if self._payload is not None:
+            payload, version = self._apply_deltas(
+                self._payload, self._version, entries
+            )
+            if version > self._version:
+                self._payload, self._version = payload, version
+        best = (self._payload, self._version)
+        if best[1] < entries[-1][0]:
+            # A full newer than what deltas reach (or no held payload):
+            # walk fulls newest-first until one chain restores.
+            fulls = [e for e in entries if e[1] == "full"]
+            for v, _kind, path in reversed(fulls):
+                if v <= best[1]:
+                    break
+                try:
+                    _, _, payload = self._read(path)
+                except ChunkCorrupt:
+                    self.corrupt_skips += 1
+                    continue
+                payload, version = self._apply_deltas(payload, v, entries)
+                if version > best[1]:
+                    best = (payload, version)
+                    self._payload, self._version = payload, version
+                break
+        if best[0] is None or best[1] <= int(have_version):
+            return None
+        return restore_like(self._template, best[0]), best[1]

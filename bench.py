@@ -131,6 +131,68 @@ def _serving_bench(clients: int = 32, duration: float = 6.0,
     }
 
 
+def _serving_net_bench(clients_per_replica: int = 4, duration: float = 6.0,
+                       network: str = "mlp", env: str = "random:84x84x1",
+                       replica_counts: str = "1,2",
+                       timeout_s: float = 560.0) -> dict:
+    """``serving_net``: the socket serving tier's scale-out point —
+    tools/loadgen.py ``--compare-replicas`` in a CPU-pinned subprocess
+    (the ``serving_qps`` isolation pattern: the child forces
+    ``jax_platforms=cpu``, a hard timeout keeps a wedged fleet from
+    eating the bench line).  One fleet per width at matched per-replica
+    offered load, over real sockets through the health-aware router,
+    with hot param reloads fanned out as page-deltas mid-window."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env_vars = dict(os.environ)
+    env_vars["JAX_PLATFORMS"] = "cpu"
+    env_vars.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize plugin gate
+    env_vars["PYTHONPATH"] = repo + os.pathsep + env_vars.get(
+        "PYTHONPATH", ""
+    )
+    cmd = [
+        sys.executable, os.path.join(repo, "tools", "loadgen.py"),
+        "--platform", "cpu",
+        "--compare-replicas", replica_counts,
+        "--clients", str(clients_per_replica),
+        "--duration", str(duration),
+        "--network", network,
+        "--env", env,
+        "--reloads", "2",
+    ]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout_s,
+        env=env_vars, cwd=repo,
+    )
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip()[-400:]
+        raise RuntimeError(f"socket loadgen rc={proc.returncode}: {tail}")
+    r = json.loads(proc.stdout.strip().splitlines()[-1])
+    runs = {
+        k: {
+            "qps": v["qps"],
+            "p50_ms": v["latency"]["p50_ms"],
+            "p99_ms": v["latency"]["p99_ms"],
+            "timeouts": v["timeouts"],
+            "shed": v["shed"],
+            "param_full_bytes": v["param_full_bytes"],
+            "delta_bytes_max": v["delta_bytes_max"],
+            "param_pushes": v["param"]["param_pushes"],
+        }
+        for k, v in r["runs"].items()
+    }
+    return {
+        "methodology": r["methodology"],
+        "runs": runs,
+        "scaleout": r["scaleout"],
+        "checks": r["checks"],
+        "note": (
+            "CPU-pinned subprocess fleet (replica children are separate "
+            "processes on this host); matched per-replica closed-loop "
+            "load, real sockets through the router, delta param fan-out"
+        ),
+    }
+
+
 def _xp_transport_bench(workers=(4, 16, 64), seconds: float = 3.0,
                         rows: int = 64, obs_shape=(84, 84, 1),
                         barrage_rounds: int = 2) -> dict:
@@ -1076,6 +1138,16 @@ def main() -> None:
     parser.add_argument("--serving-network", default="conv",
                         choices=("conv", "nature", "mlp"))
     parser.add_argument("--serving-max-batch", type=int, default=32)
+    parser.add_argument("--skip-serving-net", action="store_true",
+                        help="skip the socket serving-tier scale-out "
+                        "section (1-vs-2 replica subprocess fleets)")
+    parser.add_argument("--serving-net-clients", type=int, default=4,
+                        help="closed-loop clients PER replica for "
+                        "serving_net")
+    parser.add_argument("--serving-net-duration", type=float, default=6.0)
+    parser.add_argument("--serving-net-network", default="mlp",
+                        choices=("conv", "nature", "mlp"))
+    parser.add_argument("--serving-net-env", default="random:84x84x1")
     parser.add_argument("--skip-ckpt-stall", action="store_true",
                         help="skip the checkpoint_stall section (2M-slot "
                         "native dedup ring: ~17.6 GB RAM + a one-off "
@@ -1217,6 +1289,16 @@ def main() -> None:
                 duration=args.serving_duration,
                 network=args.serving_network,
                 max_batch=args.serving_max_batch)
+    if not args.skip_serving_net:
+        # Host-only like serving_qps: the SOCKET serving tier — 1 vs 2
+        # routed replica subprocesses at matched per-replica load, delta
+        # param fan-out cost per push (ISSUE 9; demos/serving_net.json is
+        # the committed artifact with fault injection on top).
+        section("serving_net", _serving_net_bench,
+                clients_per_replica=args.serving_net_clients,
+                duration=args.serving_net_duration,
+                network=args.serving_net_network,
+                env=args.serving_net_env)
     if not args.skip_pipeline_overlap:
         # Host-only (CPU-pinned subprocess): the overlapped dispatch
         # pipeline's sync-count / overlap accounting at depth 1/2/4 —

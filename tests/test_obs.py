@@ -448,6 +448,42 @@ class TestMetricsDocSchema:
             tr.close()
         assert set(doc) == set(stats), set(doc) ^ set(stats)
 
+    def test_serving_net_section_matches_doc(self):
+        """The serving-net schema rows (ISSUE 9 satellite): the
+        documented key list IS ServingNetServer.stats() — the JSONL
+        ``serving_net`` section and /varz ``serving.net``."""
+        from ape_x_dqn_tpu.serving.net_server import ServingNetServer
+
+        class _Stub:
+            param_version = 0
+
+            def submit(self, obs):
+                raise AssertionError("never called")
+
+        doc = _doc_keys("## Serving net schema")
+        assert doc, "Serving net schema doc section missing"
+        srv = ServingNetServer(_Stub())
+        try:
+            stats = srv.stats()
+        finally:
+            srv.close()
+        assert set(doc) == set(stats), set(doc) ^ set(stats)
+
+    def test_serving_router_section_matches_doc(self):
+        """The serving-router schema rows (ISSUE 9 satellite): the
+        documented key list IS ServingRouter.stats() — the JSONL
+        ``serving_router`` section and the fleet /varz provider."""
+        from ape_x_dqn_tpu.serving.router import ServingRouter
+
+        doc = _doc_keys("## Serving router schema")
+        assert doc, "Serving router schema doc section missing"
+        router = ServingRouter(port=0)
+        try:
+            stats = router.stats()
+        finally:
+            router.close()
+        assert set(doc) == set(stats), set(doc) ^ set(stats)
+
 
 @pytest.fixture(scope="module")
 def tiny_thread_run():

@@ -65,7 +65,7 @@ def snapshot_from_jsonl(path: str) -> dict:
     )
     out = {"learner": {k: last[k] for k in learner_keys if k in last}}
     for section in ("workers", "lineage", "xp_transport", "ckpt",
-                    "stage_us"):
+                    "stage_us", "serving_net", "serving_router"):
         if section in last:
             out[section] = last[section]
     out["t"] = last.get("t")
@@ -154,6 +154,28 @@ def render(snap: dict) -> str:
             f"({ckpt.get('bases', 0)} bases) "
             f"last_stall {ckpt.get('last_stall_ms', 0)} ms  "
             f"skips {ckpt.get('inflight_skips', 0)}"
+        )
+    snet = snap.get("serving_net") or (snap.get("serving") or {}).get("net")
+    if snet:
+        lat = snet.get("latency") or {}
+        lines.append(
+            f"-- serving net :{snet.get('port', '?')}  "
+            f"conns {snet.get('connections', 0)}  "
+            f"req {snet.get('requests', 0)}  "
+            f"shed {snet.get('shed', 0)}  "
+            f"torn {snet.get('torn_frames', 0)}  "
+            f"p99 {lat.get('p99_ms', 0)} ms  "
+            f"v{snet.get('param_version', '?')}"
+        )
+    rt = snap.get("serving_router")
+    if rt:
+        lines.append(
+            f"-- router :{rt.get('port', '?')}  "
+            f"{rt.get('healthy', 0)}/{rt.get('replicas', 0)} healthy  "
+            f"active {rt.get('active', 0)}  "
+            f"routed {rt.get('routed_total', 0)}  "
+            f"fails {rt.get('route_fails', 0)}  "
+            f"broken {rt.get('splices_broken', 0)}"
         )
     return "\n".join(lines)
 

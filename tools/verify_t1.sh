@@ -38,7 +38,14 @@
 #         resumes, a SIGKILLed worker respawns onto a fresh connection,
 #         and param fan-out cost is recorded per push
 #         (tools/net_smoke.py).
-# Gate 9: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
+# Gate 9: serving-net smoke — the network serving tier end to end: a
+#         2-replica fleet on ephemeral ports (router + delta param hub),
+#         a closed-loop client burst over real sockets, a hot param
+#         reload fanned out as page-deltas MID-BURST, one replica
+#         SIGKILLed mid-burst (drained, respawned, full-synced), zero
+#         dropped requests and fresh param_version on both replicas
+#         (tools/serving_net_smoke.py).
+# Gate 10: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
 #         command changes, change it HERE too (they must stay
 #         character-identical modulo this wrapper's cd).
 cd "$(dirname "$0")/.." || exit 1
@@ -50,4 +57,5 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/pipeline_smoke.py --steps 2
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py > /tmp/_t1_chaos.log 2>&1 || { echo "chaos smoke FAILED:"; cat /tmp/_t1_chaos.log; exit 1; }
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/spill_smoke.py > /tmp/_t1_spill.log 2>&1 || { echo "spill smoke FAILED:"; cat /tmp/_t1_spill.log; exit 1; }
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/net_smoke.py > /tmp/_t1_net.log 2>&1 || { echo "net smoke FAILED:"; cat /tmp/_t1_net.log; exit 1; }
+timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/serving_net_smoke.py > /tmp/_t1_snet.log 2>&1 || { echo "serving-net smoke FAILED:"; cat /tmp/_t1_snet.log; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
