@@ -102,6 +102,30 @@ class ActorConfig:
     # xp_ring_bytes: the bytes a worker can have in flight before its
     # writes backpressure (full_waits).
     net_conn_buf_bytes: int = 1 << 20
+    # --- wire-efficiency layers (tcp backend; runtime/net.py F_XPB) ---
+    # Payload codec for coalesced experience batches, negotiated at the
+    # connection hello.  "off" (default) keeps the v1 wire bit-identical;
+    # "zlib" deflates every batch (level 1, only kept when it shrinks);
+    # "auto" compresses only while the writer observes kernel-buffer
+    # backpressure (full_waits growing), so loopback/fast links don't pay
+    # codec CPU for bytes they don't need.
+    net_codec: str = "off"
+    # Coalescing budget: the writer packs APXT records into one wire
+    # frame per syscall until this many buffered bytes (or the max-wait
+    # below) force a flush.  0 disables coalescing — with net_codec also
+    # off that is exactly the v1 one-frame-per-record wire.
+    net_coalesce_bytes: int = 0
+    # Max milliseconds a record may sit in the coalescing buffer before a
+    # write flushes it regardless of occupancy (the worker pump also
+    # flushes at every quantum boundary).
+    net_coalesce_wait_ms: float = 20.0
+    # In-window frame dedup: within a coalesced batch, an observation
+    # frame already emitted ships once and repeats become offset refs
+    # (n-step overlap makes dense chunks ~2x frame-redundant — the wire
+    # twin of replay.dedup's frame ring).  Ingest reconstructs
+    # bit-identical records; active only when a batch frame is in use
+    # (net_coalesce_bytes > 0 or net_codec != "off").
+    net_dedup: bool = True
     # Experience-transport knobs (mode="process"; runtime/shm_ring.py).
     # Each worker incarnation gets one SIGKILL-safe shared-memory ring of
     # xp_ring_bytes: it must hold at least one chunk (a chunk is roughly
@@ -509,6 +533,18 @@ class ApexConfig:
             (a.net_conn_buf_bytes >= 1 << 16,
              "actor.net_conn_buf_bytes must be >= 64 KiB (one chunk must "
              "fit the in-flight window)"),
+            (a.net_codec in ("off", "zlib", "auto"),
+             f"unknown actor.net_codec: {a.net_codec}"),
+            (a.net_coalesce_bytes == 0 or a.net_coalesce_bytes >= 1 << 12,
+             "actor.net_coalesce_bytes must be 0 (off) or >= 4 KiB (a "
+             "budget below one record degenerates to per-record flushes)"),
+            (a.net_coalesce_wait_ms >= 0.0,
+             "actor.net_coalesce_wait_ms must be >= 0"),
+            (a.transport == "tcp"
+             or (a.net_codec == "off" and a.net_coalesce_bytes == 0),
+             "actor.net_codec / net_coalesce_bytes require "
+             "actor.transport=tcp (the shm ring is already zero-copy on "
+             "one host — there are no wire bytes to save)"),
             (0 <= a.worker_nice <= 19,
              "actor.worker_nice must be in [0, 19]"),
             (a.xp_ring_bytes >= 1 << 16,
@@ -792,6 +828,13 @@ def transport_budget(cfg: ApexConfig, num_workers: Optional[int] = None,
     (the worker_slice rule).  ``conn_drain_budget_bytes`` is the bounded
     per-connection share of the poll sweep's byte budget, the number
     runtime/transport.make_transport hands each NetChannel.
+
+    Wire-efficiency terms (tcp backend): ``coalesce_buf_bytes`` charges
+    one ``net_coalesce_bytes`` staging buffer per worker on its own host
+    plus one reassembly window per connection on the learner host;
+    ``codec_scratch_bytes`` charges the deflate/inflate scratch (bounded
+    by the coalesce budget, floored at 1 MiB for uncoalesced codec-only
+    wires) the same way.  Both are 0 with the layers off.
     """
     w = int(num_workers if num_workers is not None else cfg.actor.num_workers)
     kind = cfg.actor.transport
@@ -801,6 +844,9 @@ def transport_budget(cfg: ApexConfig, num_workers: Optional[int] = None,
     conn = int(cfg.actor.net_conn_buf_bytes)
     conn_drain = max(64 << 10, int(cfg.actor.xp_drain_budget_bytes)
                      // max(1, w))
+    coal = int(getattr(cfg.actor, "net_coalesce_bytes", 0))
+    codec_on = getattr(cfg.actor, "net_codec", "off") != "off"
+    codec_scratch = (max(coal, 1 << 20) if codec_on else 0)
     shm = kind == "shm"
     per_host = []
     for h in range(h_n):
@@ -821,6 +867,17 @@ def transport_budget(cfg: ApexConfig, num_workers: Optional[int] = None,
                 0 if shm else wh * conn + (w * conn if h == 0 else 0)
             ),
             "conn_drain_budget_bytes": 0 if shm else conn_drain,
+            # Wire-efficiency buffers: writer-side coalescing staging on
+            # each worker's host; learner host holds a per-connection
+            # reassembly window of the same size.
+            "coalesce_buf_bytes": (
+                0 if shm else wh * coal + (w * coal if h == 0 else 0)
+            ),
+            "codec_scratch_bytes": (
+                0 if shm
+                else wh * codec_scratch
+                + (w * codec_scratch if h == 0 else 0)
+            ),
         }
         per_host.append(entry)
     return {

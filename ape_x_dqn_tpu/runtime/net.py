@@ -42,6 +42,32 @@ Wire protocol (little-endian, 8-byte-aligned structs):
     new socket.  Exactly the torn-ring-tail contract, at connection
     granularity.
 
+  * **Wire-efficiency layers** (the byte-economy campaign — SEED RL's
+    observation that the actor↔learner byte path bounds fleet width once
+    actors leave the learner's host): with any of them enabled the
+    writer sends a v2 hello (codec negotiated there) and ships
+    ``F_XPB`` frames instead of one ``F_XP`` per record:
+
+      1. *Coalesced framing* — many APXT records per wire frame
+         (one syscall), bounded by ``actor.net_coalesce_bytes`` and a
+         max-wait flush; the reader drains via ``recv_into`` a
+         persistent buffer.
+      2. *Dedup-aware encoding* — inside the batch, an observation
+         frame already emitted in the coalescing window is sent once
+         and referenced by offset into the reconstructed stream
+         afterwards (the wire twin of the replay's DedupChunk frame
+         ring; n-step overlap makes dense chunks ~2x redundant).
+         Ingest reconstructs bit-identical APXT records.
+      3. *Optional compression* — a leading codec byte per batch
+         (zlib level 1); ``actor.net_codec=auto`` compresses only
+         while the writer observes backpressure (``full_waits``).
+
+    All three preserve the adversarial-decode contract: the frame crc
+    covers the ENCODED bytes, and a batch that fails to decompress,
+    references outside its own window, or disagrees with its length
+    table is counted torn, never ingested, and retires the connection.
+    With every layer off the wire is bit-identical to the v1 format.
+
 Deliberately import-light (stdlib only at module scope): worker children
 import it before jax config is pinned, and the bench's producer processes
 load it BY FILE PATH (tools/xp_transport.py) so they never pay the
@@ -51,6 +77,7 @@ package's jax import.
 from __future__ import annotations
 
 import errno
+import json
 import os
 import secrets
 import select
@@ -63,13 +90,23 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 _NET_MAGIC = b"APXN"
 _NET_VERSION = 1
+_NET_VERSION_EXT = 2                  # v2 hello: v1 fields + _HELLO_EXT
 _HELLO = struct.Struct("<4sIqqq")     # magic, version, worker_id, attempt, token
+_HELLO_EXT = struct.Struct("<BB6x")   # codec id, flags (bit0: batch frames)
 _FRAME = struct.Struct("<IIqB7x")     # len, crc32, seq, kind (24 B, aligned)
 FRAME = _FRAME                        # public alias (serving plane, tools)
 
 F_XP = 1           # worker → learner: one experience record payload
 F_PARAM_FULL = 2   # learner → worker: i64 version | snapshot blob
 F_PARAM_DELTA = 3  # learner → worker: page-delta against the previous version
+F_XPB = 4          # worker → learner: coalesced/encoded experience batch
+
+# Batch codec ids (the leading byte of every F_XPB payload, and the v2
+# hello's negotiated capability — a writer may only compress when the
+# transport's policy accepted CODEC_ZLIB at the handshake).
+CODEC_OFF = 0
+CODEC_ZLIB = 1
+_CODEC_IDS = {"off": CODEC_OFF, "zlib": CODEC_ZLIB, "auto": CODEC_ZLIB}
 
 # Serving request/reply kinds (serving/net_server.py) — the policy tier's
 # wire protocol rides the SAME frame header + crc/seq discipline, so one
@@ -94,6 +131,8 @@ _PDELTA = struct.Struct("<qqIIII")        # version, base, full_crc,
 _PIDX = struct.Struct("<I")
 
 _SEND_SLICE = 1 << 18
+_AUTO_OFF_FLUSHES = 256   # net_codec=auto: raw again after this many
+#                           backpressure-free flushes
 
 # Serving hello: clients are anonymous (no run token — the serving port is
 # a public-ish front door, not the fleet's private experience plane), but
@@ -388,6 +427,240 @@ def apply_param_delta(prev: bytes, payload: bytes) -> Tuple[int, int, bytes]:
 
 
 # ---------------------------------------------------------------------------
+# Wire-efficiency layers: the F_XPB batch container.
+#
+# Body layout (before the optional codec wrap):
+#
+#     u32 n_records | n_records x u32 record_len | segment stream
+#
+# The segment stream rebuilds the CONCATENATION of the original record
+# payloads:
+#
+#     u8 0 (literal) | u32 len | len bytes
+#     u8 1 (ref)     | u32 len | u64 offset into the reconstructed stream
+#
+# Refs only ever point BACKWARD into the stream decoded so far — the
+# coalescing window — so decode is stateless per frame: a reconnect (fresh
+# seq stream) carries no cross-frame dictionary to resynchronize.  The
+# framed payload is ``u8 codec | body`` with body zlib-deflated when
+# codec == CODEC_ZLIB; the frame crc covers these ENCODED bytes, and any
+# decode surprise raises ValueError — counted torn, never ingested.
+# ---------------------------------------------------------------------------
+
+_BU32 = struct.Struct("<I")
+_SEG_LIT = 0
+_SEG_REF = 1
+_SEGL = struct.Struct("<BI")          # literal: op, length
+_SEGR = struct.Struct("<BIQ")         # ref: op, length, stream offset
+_MAX_BATCH_RECORDS = 1 << 20
+_MIN_DEDUP_FRAME = 64                 # don't chase sub-cacheline "frames"
+
+# shm_ring's experience-record envelope + APXT prefix, mirrored here so
+# the dedup encoder can walk a record WITHOUT importing shm_ring (this
+# module stays standalone-loadable); layout equality is pinned by
+# tests/test_net_transport.py.
+_XP_ENVELOPE = struct.Struct("<B7xqdqqqqq")
+_APXT_MAGIC = b"APXT"
+_APXT_PREFIX = struct.Struct("<4sIQ")
+_DEDUP_KEYS = frozenset(("obs", "next_obs", "frames"))
+_DTYPE_SIZES = {
+    "uint8": 1, "int8": 1, "bool": 1, "uint16": 2, "int16": 2,
+    "float16": 2, "bfloat16": 2, "uint32": 4, "int32": 4, "float32": 4,
+    "uint64": 8, "int64": 8, "float64": 8,
+}
+
+
+def _frame_spans(payload) -> List[Tuple[int, int]]:
+    """(offset, nbytes) spans of the fixed-size uint8 observation frames
+    inside one experience record, in stream order — the dedup encoder's
+    candidate set.  Best-effort by design: any parse surprise returns []
+    and the record ships as one literal (dedup is an optimization layered
+    on a payload that stays byte-complete either way)."""
+    try:
+        mv = memoryview(payload)
+        off = _XP_ENVELOPE.size
+        magic, version, hlen = _APXT_PREFIX.unpack_from(mv, off)
+        if magic != _APXT_MAGIC or version != 1:
+            return []
+        off += _APXT_PREFIX.size
+        header = json.loads(bytes(mv[off:off + hlen]))
+        off += hlen
+        spans: List[Tuple[int, int]] = []
+        for leaf in header["leaves"]:
+            itemsize = _DTYPE_SIZES.get(leaf["dtype"])
+            if itemsize is None:
+                return []           # can't size this leaf: stop walking
+            shape = leaf["shape"]
+            n = 1
+            for d in shape:
+                n *= int(d)
+            nbytes = n * itemsize
+            path = leaf["path"]
+            key = path[0].get("k") if len(path) == 1 else None
+            if (key in _DEDUP_KEYS and leaf["dtype"] == "uint8"
+                    and len(shape) >= 2 and int(shape[0]) > 0):
+                rows = int(shape[0])
+                fb = nbytes // rows
+                if fb >= _MIN_DEDUP_FRAME and fb * rows == nbytes:
+                    spans.extend(
+                        (off + r * fb, fb) for r in range(rows)
+                    )
+            off += nbytes
+        if off > len(mv):
+            return []
+        return spans
+    except Exception:  # noqa: BLE001 — malformed candidate: no dedup
+        return []
+
+
+def encode_batch(records: Sequence[bytes], dedup: bool = True):
+    """(body, stats) for one F_XPB batch.  With ``dedup``, observation
+    frames repeated within the batch (n-step overlap makes obs[i+n] ==
+    next_obs[i] inside one dense chunk) ship once; repeats become refs
+    into the reconstructed stream.  Window lookups key the dict by the
+    frame BYTES (one slice copy + one siphash per frame — measured
+    cheaper than any crc-bucket scheme on this interpreter, and exact by
+    construction: a ref is only ever emitted for full byte equality)."""
+    parts: List = [_BU32.pack(len(records))]
+    parts += [_BU32.pack(len(r)) for r in records]
+    seen: Dict[bytes, int] = {}   # frame bytes -> offset in the stream
+    base = 0
+    hits = saved = 0
+    for rec in records:
+        mrec = memoryview(rec)
+        lit = 0
+        for off, fb in (_frame_spans(rec) if dedup else ()):
+            prev = seen.setdefault(rec[off:off + fb], base + off)
+            if prev == base + off:
+                continue                 # first sighting: ships literal
+            if off > lit:
+                parts.append(_SEGL.pack(_SEG_LIT, off - lit))
+                parts.append(mrec[lit:off])
+            parts.append(_SEGR.pack(_SEG_REF, fb, prev))
+            lit = off + fb
+            hits += 1
+            saved += fb
+        if len(rec) > lit:
+            parts.append(_SEGL.pack(_SEG_LIT, len(rec) - lit))
+            parts.append(mrec[lit:] if lit else rec)
+        base += len(rec)
+    return b"".join(parts), {"dedup_hits": hits, "dedup_bytes": saved}
+
+
+def decode_batch(body) -> List:
+    """Record payloads from one F_XPB body, bit-identical to what
+    ``encode_batch`` consumed — as READ-ONLY memoryviews over one shared
+    reconstruction buffer (the zero-copy hand-off the shm reader makes
+    to replay ingest; the buffer lives exactly as long as any record
+    view does).  Raises ValueError on ANY malformation — truncated
+    tables, a ref outside the decoded window, a stream that disagrees
+    with its length table — the caller counts torn and retires the
+    connection."""
+    mv = memoryview(body)
+    end = len(mv)
+    if end < _BU32.size:
+        raise ValueError("batch: truncated record count")
+    (n,) = _BU32.unpack_from(mv, 0)
+    if not 0 < n <= _MAX_BATCH_RECORDS:
+        raise ValueError(f"batch: absurd record count {n}")
+    off = _BU32.size * (1 + n)
+    if end < off:
+        raise ValueError("batch: truncated length table")
+    lens = struct.unpack_from(f"<{n}I", mv, _BU32.size)
+    total = sum(lens)
+    if total > _MAX_FRAME:
+        raise ValueError("batch: absurd logical size")
+    # Preallocated reconstruction: segment copies land straight in place
+    # (growth-free — this loop is on the learner's drain path).
+    out = bytearray(total)
+    mo = memoryview(out)
+    pos = 0
+    while off < end:
+        op = mv[off]
+        if op == _SEG_LIT:
+            if off + _SEGL.size > end:
+                raise ValueError("batch: truncated literal header")
+            _, ln = _SEGL.unpack_from(mv, off)
+            off += _SEGL.size
+            if ln == 0 or off + ln > end:
+                raise ValueError("batch: truncated literal")
+            if pos + ln > total:
+                raise ValueError("batch: stream overruns its length table")
+            mo[pos:pos + ln] = mv[off:off + ln]
+            pos += ln
+            off += ln
+        elif op == _SEG_REF:
+            if off + _SEGR.size > end:
+                raise ValueError("batch: truncated ref")
+            _, ln, src = _SEGR.unpack_from(mv, off)
+            off += _SEGR.size
+            if ln == 0 or src + ln > pos:
+                raise ValueError("batch: ref outside the decoded window")
+            if pos + ln > total:
+                raise ValueError("batch: stream overruns its length table")
+            # src + ln <= pos (checked above): source and destination
+            # never overlap.
+            mo[pos:pos + ln] = mo[src:src + ln]
+            pos += ln
+        else:
+            raise ValueError(f"batch: unknown segment op {op}")
+    if pos != total:
+        raise ValueError("batch: stream shorter than its length table")
+    ro = mo.toreadonly()
+    recs: List = []
+    p = 0
+    for ln in lens:
+        recs.append(ro[p:p + ln])
+        p += ln
+    return recs
+
+
+def encode_xpb_payload(records: Sequence[bytes], codec: int = CODEC_OFF,
+                       dedup: bool = True, level: int = 1):
+    """(payload, stats) — the framed F_XPB payload (codec byte + body).
+    zlib only sticks when it actually shrinks the body (a batch of
+    incompressible frames ships raw under the same codec negotiation)."""
+    body, st = encode_batch(records, dedup=dedup)
+    used = CODEC_OFF
+    if codec == CODEC_ZLIB:
+        comp = zlib.compress(body, level)
+        if len(comp) < len(body):
+            body = comp
+            used = CODEC_ZLIB
+    st["compressed"] = used == CODEC_ZLIB
+    return bytes((used,)) + body, st
+
+
+def decode_xpb_payload(payload, allow_zlib: bool = True,
+                       max_bytes: int = _MAX_FRAME) -> List[bytes]:
+    """Record payloads from one verified F_XPB frame payload.  A zlib
+    body is bounded (``max_bytes``) against decompression bombs and must
+    terminate its stream exactly (zlib's adler32 makes a mid-body bitflip
+    the sampled frame crc missed fail HERE); a compressed payload on a
+    connection whose hello negotiated codec off is a protocol violation.
+    Every fault raises ValueError — torn, never ingested."""
+    if len(payload) < 1:
+        raise ValueError("batch: empty payload")
+    codec = payload[0]
+    body = memoryview(payload)[1:]
+    if codec == CODEC_ZLIB:
+        if not allow_zlib:
+            raise ValueError("batch: compressed payload but codec "
+                             "negotiated off")
+        d = zlib.decompressobj()
+        try:
+            body = d.decompress(bytes(body), max_bytes + 1)
+        except zlib.error as e:
+            raise ValueError(f"batch: decompress failed: {e}") from None
+        if (not d.eof or d.unconsumed_tail or d.unused_data
+                or len(body) > max_bytes):
+            raise ValueError("batch: decompress truncated/oversize")
+    elif codec != CODEC_OFF:
+        raise ValueError(f"batch: unknown codec {codec}")
+    return decode_batch(body)
+
+
+# ---------------------------------------------------------------------------
 # Learner side: listener + per-worker channels.
 # ---------------------------------------------------------------------------
 
@@ -426,13 +699,24 @@ class NetChannel:
         self.param_bytes_sent = 0
         self._ever_connected = False
         self.full_waits = 0          # backpressure lives worker-side (0)
+        # Wire-efficiency accounting (docs/METRICS.md net schema):
+        # wire bytes are raw_bytes_in; these count the LOGICAL side.
+        self.codec = CODEC_OFF       # negotiated at adopt (v2 hello ext)
+        self.wire_frames = 0         # accepted xp wire frames (F_XP|F_XPB)
+        self.coalesced_frames = 0    # F_XPB batches among them
+        self.codec_frames = 0        # compressed batches among those
+        self.logical_bytes = 0       # decoded record bytes delivered
+        self.decode_s = 0.0          # batch decompress+reconstruct time
+        self._rbuf = bytearray(_RECV_CHUNK)  # persistent recv_into scratch
 
     # -- connection lifecycle ---------------------------------------------
 
-    def adopt(self, sock: socket.socket) -> None:
+    def adopt(self, sock: socket.socket, codec: int = CODEC_OFF) -> None:
         """Route a freshly-handshaked connection here.  A live previous
         connection is retired first (its partial frame, if any, counts
-        torn — same as a disconnect)."""
+        torn — same as a disconnect).  ``codec`` is the hello-negotiated
+        batch codec this connection may use; a compressed batch on an
+        off-codec connection decodes as a protocol violation."""
         with self._send_lock:
             if self._sock is not None or self._ever_connected:
                 self.reconnects += int(self._ever_connected)
@@ -441,8 +725,36 @@ class NetChannel:
             self._sock = sock
             self._parser = FrameParser(crc_full=self._crc_full)
             self._out_seq = 0
+            self.codec = int(codec)
             self.param_sent_version = -1
             self._ever_connected = True
+
+    def _accept_frame(self, kind: int, payload: bytes) -> bool:
+        """Route one crc/seq-verified frame into the ready queue; False =
+        protocol violation (wrong kind, un-negotiated codec, or a batch
+        that fails to decode) — the caller counts torn and retires."""
+        if kind == F_XP:
+            self._ready.append((kind, payload))
+            self.wire_frames += 1
+            self.logical_bytes += len(payload)
+            return True
+        if kind == F_XPB:
+            t0 = time.perf_counter()
+            try:
+                recs = decode_xpb_payload(
+                    payload, allow_zlib=self.codec != CODEC_OFF
+                )
+            except ValueError:
+                return False
+            self.decode_s += time.perf_counter() - t0
+            self.wire_frames += 1
+            self.coalesced_frames += 1
+            self.codec_frames += int(payload[:1] == b"\x01")
+            for r in recs:
+                self._ready.append((F_XP, r))
+                self.logical_bytes += len(r)
+            return True
+        return False
 
     def _retire_conn_locked(self) -> None:
         # Deliver every frame that already verified BEFORE declaring the
@@ -453,12 +765,10 @@ class NetChannel:
             got = self._parser.next()
             if got is None:
                 break
-            kind, payload = got
-            if kind != F_XP:
+            if not self._accept_frame(*got):
                 self.torn_frames += 1
                 self._parser = FrameParser(crc_full=self._crc_full)
                 break
-            self._ready.append((kind, payload))
         if self._parser.pending() or self._parser.error is not None:
             self.torn_frames += 1
             self._parser = FrameParser(crc_full=self._crc_full)
@@ -482,21 +792,24 @@ class NetChannel:
         budget = self._drain_budget
         while budget > 0:
             try:
-                data = sock.recv(min(_RECV_CHUNK, budget))
+                # recv_into the persistent scratch: no per-sweep bytes
+                # allocation on the hot drain path (the parser's append
+                # is the one remaining copy).
+                n = sock.recv_into(self._rbuf, min(_RECV_CHUNK, budget))
             except (BlockingIOError, InterruptedError):
                 return
             except OSError:
                 with self._send_lock:
                     self._retire_conn_locked()
                 return
-            if not data:
+            if n == 0:
                 # Orderly close: a truncated frame in the buffer is torn.
                 with self._send_lock:
                     self._retire_conn_locked()
                 return
-            budget -= len(data)
-            self.raw_bytes_in += len(data)
-            self._parser.feed(data)
+            budget -= n
+            self.raw_bytes_in += n
+            self._parser.feed(memoryview(self._rbuf)[:n])
 
     def _drain_parser(self) -> None:
         while True:
@@ -508,15 +821,14 @@ class NetChannel:
                     with self._send_lock:
                         self._retire_conn_locked()
                 return
-            kind, payload = got
-            if kind != F_XP:
+            if not self._accept_frame(*got):
                 # Protocol violation from a worker (param kinds only flow
-                # learner→worker): treat as stream corruption.
+                # learner→worker; an undecodable batch is stream
+                # corruption however well it framed).
                 self.torn_frames += 1
                 with self._send_lock:
                     self._retire_conn_locked()
                 return
-            self._ready.append((kind, payload))
 
     def read_next(self) -> Optional[bytes]:
         """The next verified experience payload, or None — the exact
@@ -625,12 +937,21 @@ class NetTransport:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  drain_budget_per_conn: int = 1 << 20,
                  conn_buf_bytes: int = 1 << 20, crc_full: bool = False,
-                 hello_timeout_s: float = 5.0):
+                 hello_timeout_s: float = 5.0, codec: str = "off"):
+        if codec not in _CODEC_IDS:
+            raise ValueError(f"unknown net codec: {codec}")
         self.host = host
         self._conn_buf = int(conn_buf_bytes)
         self._drain_budget = int(drain_budget_per_conn)
         self._crc_full = bool(crc_full)
         self._hello_timeout = float(hello_timeout_s)
+        # Accept policy for v2 hellos: "off" admits only codec-off
+        # writers; "zlib"/"auto" additionally admit zlib-capable ones.
+        self._codec_policy = codec
+        self._accept_codecs = (
+            {CODEC_OFF} if codec == "off" else {CODEC_OFF, CODEC_ZLIB}
+        )
+        self.codec_rejects = 0
         self.token = secrets.randbits(63) or 1
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -660,7 +981,9 @@ class NetTransport:
         # with it — stats() reports base + live sums, the pool's
         # _full_waits_base discipline.
         self._base = {"bytes_in": 0, "frames_in": 0, "torn_frames": 0,
-                      "reconnects": 0}
+                      "reconnects": 0, "logical_bytes": 0, "wire_frames": 0,
+                      "coalesced_frames": 0, "codec_frames": 0,
+                      "decode_s": 0.0}
         self._closed = False
 
     # -- channel registry --------------------------------------------------
@@ -680,6 +1003,11 @@ class NetTransport:
         self._base["frames_in"] += ch.records_read + len(ch._ready)
         self._base["torn_frames"] += ch.torn_live
         self._base["reconnects"] += ch.reconnects
+        self._base["logical_bytes"] += ch.logical_bytes
+        self._base["wire_frames"] += ch.wire_frames
+        self._base["coalesced_frames"] += ch.coalesced_frames
+        self._base["codec_frames"] += ch.codec_frames
+        self._base["decode_s"] += ch.decode_s
 
     def drop_channel(self, wid: int, channel: NetChannel) -> None:
         with self._lock:
@@ -713,11 +1041,21 @@ class NetTransport:
         for ent in self._pending:
             sock, buf, deadline = ent
             try:
-                while len(buf) < _HELLO.size:
-                    data = sock.recv(_HELLO.size - len(buf))
+                # v1 hellos are _HELLO.size bytes; a v2 version word
+                # promises a feature extension right behind it.
+                need = _HELLO.size
+                if len(buf) >= _HELLO.size:
+                    need += _HELLO_EXT.size * int(
+                        _HELLO.unpack_from(buf, 0)[1] == _NET_VERSION_EXT
+                    )
+                while len(buf) < need:
+                    data = sock.recv(need - len(buf))
                     if not data:
                         raise OSError("eof before hello")
                     buf += data
+                    if len(buf) == _HELLO.size and \
+                            _HELLO.unpack_from(buf, 0)[1] == _NET_VERSION_EXT:
+                        need = _HELLO.size + _HELLO_EXT.size
             except (BlockingIOError, InterruptedError):
                 if time.monotonic() > deadline:
                     self.rejects += 1
@@ -736,18 +1074,37 @@ class NetTransport:
         self._pending = still
 
     def _route(self, sock: socket.socket, hello: bytes) -> None:
+        conn_codec = CODEC_OFF
         try:
-            magic, version, wid, attempt, token = _HELLO.unpack(hello)
+            magic, version, wid, attempt, token = _HELLO.unpack_from(
+                hello, 0
+            )
+            if version == _NET_VERSION_EXT:
+                if len(hello) != _HELLO.size + _HELLO_EXT.size:
+                    raise struct.error("v2 hello without its extension")
+                conn_codec, _flags = _HELLO_EXT.unpack_from(
+                    hello, _HELLO.size
+                )
+            elif len(hello) != _HELLO.size:
+                raise struct.error("hello length mismatch")
         except struct.error:
             magic = b""
             version = wid = attempt = token = -1
         with self._lock:
             ch = self._channels.get(wid)
             ok = (
-                magic == _NET_MAGIC and version == _NET_VERSION
+                magic == _NET_MAGIC
+                and version in (_NET_VERSION, _NET_VERSION_EXT)
                 and token == self.token and ch is not None
                 and ch.attempt == attempt
             )
+            if ok and conn_codec not in self._accept_codecs:
+                # Codec-mismatch hello: the writer proposes a codec this
+                # transport's policy refuses — reject BEFORE any framing
+                # state exists (the adversarial-decode contract's
+                # handshake rung), counted separately for the operator.
+                self.codec_rejects += 1
+                ok = False
             if not ok:
                 self.rejects += 1
                 try:
@@ -755,7 +1112,7 @@ class NetTransport:
                 except OSError:
                     pass
                 return
-            ch.adopt(sock)
+            ch.adopt(sock, codec=conn_codec)
             payload, pversion = self._param_payload, self._param_version
         # Fresh connection: the current snapshot rides down immediately
         # (full — the worker has no baseline), so a worker that connects
@@ -839,6 +1196,15 @@ class NetTransport:
             channels = list(self._channels.values())
             base = dict(self._base)
         bytes_in = base["bytes_in"] + sum(c.raw_bytes_in for c in channels)
+        logical = base["logical_bytes"] + sum(
+            c.logical_bytes for c in channels
+        )
+        wire_frames = base["wire_frames"] + sum(
+            c.wire_frames for c in channels
+        )
+        frames_in = base["frames_in"] + sum(
+            c.records_read + len(c._ready) for c in channels
+        )
         now = time.monotonic()
         dt = max(1e-3, now - self._rate_t)
         rate = max(0.0, bytes_in - self._rate_bytes) / dt
@@ -849,9 +1215,29 @@ class NetTransport:
             "expected": len(channels),
             "bytes_in": bytes_in,
             "bytes_in_per_s": round(rate, 1),
-            "frames_in": base["frames_in"] + sum(
-                c.records_read + len(c._ready) for c in channels
+            "frames_in": frames_in,
+            # Wire-efficiency surface: logical bytes are the decoded APXT
+            # record bytes replay ingest sees; wire bytes (bytes_in) fall
+            # below them when dedup/compression are winning.
+            "logical_bytes_in": logical,
+            "wire_over_logical": (
+                round(bytes_in / logical, 4) if logical else None
             ),
+            "wire_frames_in": wire_frames,
+            "coalesced_frames_in": base["coalesced_frames"] + sum(
+                c.coalesced_frames for c in channels
+            ),
+            "records_per_frame": round(
+                frames_in / max(1, wire_frames), 2
+            ),
+            "codec": self._codec_policy,
+            "codec_frames_in": base["codec_frames"] + sum(
+                c.codec_frames for c in channels
+            ),
+            "codec_ms": round(1e3 * (base["decode_s"] + sum(
+                c.decode_s for c in channels
+            )), 1),
+            "codec_rejects": self.codec_rejects,
             "torn_frames": base["torn_frames"] + sum(
                 c.torn_live for c in channels
             ),
@@ -928,6 +1314,24 @@ class NetWriter:
         self.token = int(spec["token"])
         self._conn_buf = int(spec.get("conn_buf", 1 << 20))
         self._crc_full = bool(crc_full)
+        # Wire-efficiency knobs (spec defaults keep legacy specs — tests,
+        # old tooling — on the bit-identical v1 wire).
+        self._codec = str(spec.get("codec", "off"))
+        if self._codec not in _CODEC_IDS:
+            raise ValueError(f"unknown net codec: {self._codec}")
+        self._coalesce = int(spec.get("coalesce", 0))
+        self._coal_wait_ms = float(spec.get("coalesce_wait_ms", 20.0))
+        self._dedup = bool(spec.get("dedup", True))
+        self._features = self._codec != "off" or self._coalesce > 0
+        self._coal: List[bytes] = []
+        self._coal_bytes = 0
+        self._coal_t0 = 0.0
+        # net_codec=auto control loop: compress only while the kernel
+        # buffer backpressures (full_waits growing); fall back to raw
+        # after a long quiet spell so fast links stop paying codec CPU.
+        self._auto_on = False
+        self._auto_idle = 0
+        self._auto_fw_mark = 0
         self._sock: Optional[socket.socket] = None
         self._seq = 0
         self._parser = FrameParser(crc_full=crc_full)
@@ -935,7 +1339,12 @@ class NetWriter:
         self.full_waits = 0
         self.reconnects = 0
         self.records_written = 0
-        self.bytes_written = 0
+        self.bytes_written = 0       # wire bytes (frames as sent)
+        self.logical_bytes_out = 0   # record bytes before encoding
+        self.flushes = 0             # F_XPB frames sent
+        self.compressed_frames = 0
+        self.dedup_ref_bytes = 0     # bytes replaced by window refs
+        self.codec_s = 0.0           # encode (dedup scan + deflate) time
         self.param_crc_errors = 0
         self._param_payload: Optional[bytes] = None
         self._param_version = -1
@@ -967,8 +1376,17 @@ class NetWriter:
                                 self._conn_buf)
             except OSError:
                 pass
-            sock.sendall(_HELLO.pack(_NET_MAGIC, _NET_VERSION, self.wid,
-                                     self.attempt, self.token))
+            hello = _HELLO.pack(
+                _NET_MAGIC,
+                _NET_VERSION_EXT if self._features else _NET_VERSION,
+                self.wid, self.attempt, self.token,
+            )
+            if self._features:
+                # v2 extension: propose the codec capability ("auto"
+                # proposes zlib — whether a given frame compresses is the
+                # writer's per-flush decision) + the batch-frames flag.
+                hello += _HELLO_EXT.pack(_CODEC_IDS[self._codec], 1)
+            sock.sendall(hello)
             sock.setblocking(False)
         except OSError:
             self._backoff.fail()
@@ -983,13 +1401,15 @@ class NetWriter:
 
     # -- experience writes (the ring-writer surface) -----------------------
 
-    def write(self, parts: Sequence, should_stop: Optional[Callable] = None,
-              sleep_s: float = 0.001, timeout: Optional[float] = None) -> bool:
-        """Blocking send of one experience record with backpressure and
-        reconnect; aborts (False) on ``should_stop`` or ``timeout`` —
-        the exact ShmRing.write contract."""
-        payload = b"".join(_as_bytes(p) for p in parts)
-        deadline = time.monotonic() + timeout if timeout else None
+    def _send_frame(self, kind: int, payload: bytes,
+                    should_stop: Optional[Callable] = None,
+                    sleep_s: float = 0.001,
+                    deadline: Optional[float] = None) -> bool:
+        """Blocking send of one frame with backpressure and reconnect;
+        aborts (False) on ``should_stop`` or the deadline.  On a mid-send
+        connection loss the frame is rebuilt whole against the fresh
+        connection's seq stream (the documented at-most-one-duplicate
+        contract)."""
         buf: Optional[memoryview] = None
         off = 0
         while True:
@@ -1006,7 +1426,7 @@ class NetWriter:
                 buf = memoryview(
                     _FRAME.pack(len(payload),
                                 _crc_payload(payload, self._crc_full),
-                                self._seq + 1, F_XP) + payload
+                                self._seq + 1, kind) + payload
                 )
                 off = 0
             try:
@@ -1023,10 +1443,91 @@ class NetWriter:
                 continue
             if off >= len(buf):
                 self._seq += 1
-                self.records_written += 1
                 self.bytes_written += len(buf)
                 self.pump_params()
                 return True
+
+    def write(self, parts: Sequence, should_stop: Optional[Callable] = None,
+              sleep_s: float = 0.001, timeout: Optional[float] = None) -> bool:
+        """Blocking send of one experience record with backpressure and
+        reconnect; aborts (False) on ``should_stop`` or ``timeout`` —
+        the exact ShmRing.write contract.  With the wire-efficiency
+        layers enabled the record lands in the coalescing buffer and the
+        wire send happens at the flush boundary (budget reached, max-wait
+        elapsed, or an explicit ``flush()``)."""
+        payload = b"".join(_as_bytes(p) for p in parts)
+        deadline = time.monotonic() + timeout if timeout else None
+        if not self._features:
+            # Legacy path: one F_XP frame per record, bit-identical to
+            # the v1 wire format.
+            if not self._send_frame(F_XP, payload, should_stop, sleep_s,
+                                    deadline):
+                return False
+            self.records_written += 1
+            self.logical_bytes_out += len(payload)
+            return True
+        now = time.monotonic()
+        if not self._coal:
+            self._coal_t0 = now
+        self._coal.append(payload)
+        self._coal_bytes += len(payload)
+        if (self._coalesce <= 0
+                or self._coal_bytes >= self._coalesce
+                or (now - self._coal_t0) * 1e3 >= self._coal_wait_ms):
+            return self._flush(should_stop, sleep_s, deadline)
+        return True
+
+    def _effective_codec(self) -> int:
+        if self._codec == "zlib":
+            return CODEC_ZLIB
+        if self._codec == "auto" and self._auto_on:
+            return CODEC_ZLIB
+        return CODEC_OFF
+
+    def _auto_update(self) -> None:
+        if self._codec != "auto":
+            return
+        if self.full_waits > self._auto_fw_mark:
+            self._auto_fw_mark = self.full_waits
+            self._auto_on = True
+            self._auto_idle = 0
+        elif self._auto_on:
+            self._auto_idle += 1
+            if self._auto_idle >= _AUTO_OFF_FLUSHES:
+                self._auto_on = False
+
+    def _flush(self, should_stop: Optional[Callable] = None,
+               sleep_s: float = 0.001,
+               deadline: Optional[float] = None) -> bool:
+        if not self._coal:
+            return True
+        records = self._coal
+        n_logical = self._coal_bytes
+        self._coal = []
+        self._coal_bytes = 0
+        t0 = time.perf_counter()
+        payload, st = encode_xpb_payload(
+            records, codec=self._effective_codec(), dedup=self._dedup
+        )
+        self.codec_s += time.perf_counter() - t0
+        self.dedup_ref_bytes += st["dedup_bytes"]
+        ok = self._send_frame(F_XPB, payload, should_stop, sleep_s,
+                              deadline)
+        if ok:
+            self.flushes += 1
+            self.compressed_frames += int(st["compressed"])
+            self.records_written += len(records)
+            self.logical_bytes_out += n_logical
+        self._auto_update()
+        return ok
+
+    def flush(self, should_stop: Optional[Callable] = None,
+              sleep_s: float = 0.001,
+              timeout: Optional[float] = None) -> bool:
+        """Push any coalesced records to the wire now (quantum
+        boundaries, teardown) — no-op on the legacy path."""
+        deadline = time.monotonic() + timeout if timeout else None
+        return self._flush(should_stop, sleep_s, deadline)
 
     # -- param subscription -------------------------------------------------
 
@@ -1091,4 +1592,12 @@ class NetWriter:
     # -- lifecycle ----------------------------------------------------------
 
     def close(self) -> None:
+        # Orderly teardown flushes the coalescing buffer (bounded — a
+        # dead learner must not wedge a stopping worker); a SIGKILL loses
+        # it, exactly like bytes the kernel hadn't flushed.
+        if self._coal and self._ever_connected:
+            try:
+                self._flush(deadline=time.monotonic() + 2.0)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         self._drop_conn()

@@ -494,6 +494,13 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                         "trace_chunk", trace_id=trace_id,
                         rows=len(c.priorities), v=fleet.param_version,
                     )
+            # Quantum-boundary flush (tcp wire-efficiency layers): the
+            # coalescing buffer must not hold records across a collect —
+            # the max-wait bound is for bursts WITHIN a write loop, this
+            # is the between-bursts bound.  shm rings have no flush.
+            flush = getattr(ring, "flush", None)
+            if flush is not None:
+                flush(should_stop=stop_evt.is_set)
             write_s += time.monotonic() - t0
             if ep_stats:
                 episodes_total += len(ep_stats)

@@ -67,17 +67,28 @@ class ShmTransport:
 
 class TcpTransport:
     """TCP backend: wraps the learner-side NetTransport (listener +
-    per-worker channels + param fan-out)."""
+    per-worker channels + param fan-out).  The wire-efficiency layers
+    (coalesced F_XPB frames, in-window frame dedup, negotiated payload
+    codec — runtime/net.py) are config-driven and ride the endpoint spec
+    to each worker's NetWriter; with all of them off the wire stays
+    bit-identical to the v1 format."""
 
     kind = "tcp"
 
     def __init__(self, host: str, port: int, drain_budget_per_conn: int,
-                 conn_buf_bytes: int):
+                 conn_buf_bytes: int, codec: str = "off",
+                 coalesce_bytes: int = 0, coalesce_wait_ms: float = 20.0,
+                 dedup: bool = True):
         self.net = NetTransport(
             host=host, port=port,
             drain_budget_per_conn=drain_budget_per_conn,
             conn_buf_bytes=conn_buf_bytes,
+            codec=codec,
         )
+        self._codec = str(codec)
+        self._coalesce = int(coalesce_bytes)
+        self._coal_wait_ms = float(coalesce_wait_ms)
+        self._dedup = bool(dedup)
 
     @property
     def port(self) -> int:
@@ -98,6 +109,8 @@ class TcpTransport:
             "kind": "tcp", "host": host, "port": self.net.port,
             "token": self.net.token, "wid": int(wid),
             "attempt": int(attempt),
+            "codec": self._codec, "coalesce": self._coalesce,
+            "coalesce_wait_ms": self._coal_wait_ms, "dedup": self._dedup,
         }
 
     def pump(self) -> None:
@@ -129,6 +142,11 @@ def make_transport(cfg, num_workers: int, ring_bytes: int,
             port=cfg.actor.transport_port,
             drain_budget_per_conn=per_conn,
             conn_buf_bytes=cfg.actor.net_conn_buf_bytes,
+            codec=getattr(cfg.actor, "net_codec", "off"),
+            coalesce_bytes=getattr(cfg.actor, "net_coalesce_bytes", 0),
+            coalesce_wait_ms=getattr(cfg.actor, "net_coalesce_wait_ms",
+                                     20.0),
+            dedup=getattr(cfg.actor, "net_dedup", True),
         )
     raise ValueError(f"unknown actor.transport: {kind}")
 

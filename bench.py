@@ -222,10 +222,13 @@ def _xp_transport_bench(workers=(4, 16, 64), seconds: float = 3.0,
 def _xp_net_bench(workers=(4, 16, 64), seconds: float = 3.0,
                   rows: int = 64, obs_shape=(84, 84, 1)) -> dict:
     """``xp_net``: shm ring vs the TCP transport backend on loopback
-    (ISSUE 8) — the identical CRC-framed APXT records through
-    runtime/net.py's socket path, at three fleet widths.  Loopback is
-    the cross-host transport's upper bound: it pays the framing, crc,
-    kernel socket path and per-frame copies, but no wire latency.
+    (ISSUE 8), now with the wire-efficiency legs alongside (ISSUE 10) —
+    plain v1 frames vs coalesce+dedup vs coalesce+dedup+zlib, all
+    carrying identical APXT records built from trajectory-shaped chunks
+    (matched settings), with wire-vs-logical bytes/transition per leg.
+    Loopback is the cross-host transport's upper bound: it pays the
+    framing, crc, kernel socket path and per-frame copies, but no wire
+    latency.
 
     Host-only by construction (tools/xp_transport.py loads shm_ring.py
     and net.py by file path; no process imports jax), so the section

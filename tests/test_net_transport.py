@@ -14,9 +14,14 @@ import pytest
 from ape_x_dqn_tpu.runtime.net import (
     _FRAME,
     _HELLO,
+    _HELLO_EXT,
     _NET_MAGIC,
     _NET_VERSION,
+    _NET_VERSION_EXT,
+    CODEC_OFF,
+    CODEC_ZLIB,
     F_XP,
+    F_XPB,
     Backoff,
     FrameParser,
     NetTransport,
@@ -24,9 +29,35 @@ from ape_x_dqn_tpu.runtime.net import (
     apply_param_delta,
     build_param_delta,
     build_param_full,
+    decode_batch,
+    decode_xpb_payload,
+    encode_batch,
+    encode_xpb_payload,
     frame_bytes,
 )
 from ape_x_dqn_tpu.runtime.shm_ring import XP, decode_chunk, encode_chunk_parts
+
+
+def _chunk_record(rows=8, n_step=3, seed=0, shape=(32, 32, 1),
+                  version=1) -> bytes:
+    """One dense XP record with the PRODUCTION n-step frame overlap
+    (obs[i + n] == next_obs[i]) — the redundancy the wire dedup layer
+    exists to remove."""
+    rng = np.random.default_rng(seed)
+    frames = rng.integers(0, 255, (rows + n_step, *shape), dtype=np.uint8)
+    arrays = {
+        "prio": (np.abs(rng.normal(size=rows)) + 0.1).astype(np.float32),
+        "obs": frames[:rows],
+        "action": rng.integers(0, 4, (rows,), dtype=np.int32),
+        "reward": rng.normal(size=(rows,)).astype(np.float32),
+        "discount": np.full((rows,), 0.97, np.float32),
+        "next_obs": frames[n_step:rows + n_step],
+    }
+    parts = encode_chunk_parts(XP, version, rows, arrays)
+    return b"".join(
+        bytes(memoryview(p).cast("B")) if not isinstance(p, bytes) else p
+        for p in parts
+    )
 
 
 def _frames(*payloads, start_seq=1):
@@ -137,9 +168,10 @@ class TestParamDelta:
         assert v == 9 and payload[8:] == b"blob-bytes"
 
 
-def _hello(tr, wid=0, attempt=0, token=None, version=_NET_VERSION):
+def _hello(tr, wid=0, attempt=0, token=None, version=_NET_VERSION,
+           ext=b""):
     return _HELLO.pack(_NET_MAGIC, version, wid, attempt,
-                       tr.token if token is None else token)
+                       tr.token if token is None else token) + ext
 
 
 def _connect_raw(tr, **kw):
@@ -512,6 +544,79 @@ class TestTransportBudgetPerHost:
         # Per-connection drain bound = sweep budget / fleet width.
         assert hosts[0]["conn_drain_budget_bytes"] == 1 << 20
 
+    def test_wire_efficiency_terms_and_legacy_keys_pinned(self):
+        """The codec/coalesce buffer terms (ISSUE 10 satellite): staging
+        on each worker's host + a per-connection reassembly window and
+        codec scratch on the learner host — and every LEGACY key at the
+        same settings is byte-for-byte what it was before the layers
+        existed (shm and plain tcp both report the new terms as 0)."""
+        from ape_x_dqn_tpu.config import ApexConfig, transport_budget
+
+        cfg = ApexConfig()
+        cfg.actor.transport = "tcp"
+        cfg.actor.transport_hosts = 2
+        cfg.actor.net_conn_buf_bytes = 1 << 20
+        cfg.actor.xp_drain_budget_bytes = 64 << 20
+        cfg.actor.net_codec = "zlib"
+        cfg.actor.net_coalesce_bytes = 2 << 20
+        cfg.validate()
+        b = transport_budget(cfg, num_workers=8)
+        hosts = b["per_host"]
+        # Legacy keys unchanged by the new layers.
+        assert b["ring_bytes_total"] == 0 and b["shm_segments"] == 0
+        assert b["fds_per_worker"] == 5
+        assert hosts[0]["sock_buf_bytes"] == (4 + 8) << 20
+        assert hosts[1]["sock_buf_bytes"] == 4 << 20
+        assert hosts[0]["conn_drain_budget_bytes"] == 8 << 20
+        # New terms: 4 local workers' staging + 8 connections' windows
+        # on host 0; workers' staging only on host 1.
+        assert hosts[0]["coalesce_buf_bytes"] == (4 + 8) * (2 << 20)
+        assert hosts[1]["coalesce_buf_bytes"] == 4 * (2 << 20)
+        # Codec scratch tracks the coalesce budget when compression is on.
+        assert hosts[0]["codec_scratch_bytes"] == (4 + 8) * (2 << 20)
+        assert hosts[1]["codec_scratch_bytes"] == 4 * (2 << 20)
+        # Codec off, coalesce off => both terms vanish; legacy unchanged.
+        cfg.actor.net_codec = "off"
+        cfg.actor.net_coalesce_bytes = 0
+        b2 = transport_budget(cfg, num_workers=8)
+        assert all(h["coalesce_buf_bytes"] == 0 for h in b2["per_host"])
+        assert all(h["codec_scratch_bytes"] == 0 for h in b2["per_host"])
+        assert b2["per_host"][0]["sock_buf_bytes"] == (4 + 8) << 20
+        # Codec-only wires still budget inflate/deflate scratch (floored).
+        cfg.actor.net_codec = "auto"
+        b3 = transport_budget(cfg, num_workers=8)
+        assert b3["per_host"][1]["codec_scratch_bytes"] == 4 << 20
+        assert b3["per_host"][1]["coalesce_buf_bytes"] == 0
+        # The shm backend never grows these terms.
+        cfg2 = ApexConfig()
+        b4 = transport_budget(cfg2, num_workers=4)
+        assert b4["per_host"][0]["coalesce_buf_bytes"] == 0
+        assert b4["per_host"][0]["codec_scratch_bytes"] == 0
+        assert b4["shm_segments"] == 5      # legacy pin: rings + params
+
+    def test_wire_knob_validation(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+
+        cfg = ApexConfig()
+        cfg.actor.transport = "tcp"
+        cfg.actor.net_codec = "gzip9"
+        with pytest.raises(ValueError, match="net_codec"):
+            cfg.validate()
+        cfg = ApexConfig()
+        cfg.actor.transport = "tcp"
+        cfg.actor.net_coalesce_bytes = 512
+        with pytest.raises(ValueError, match="net_coalesce_bytes"):
+            cfg.validate()
+        cfg = ApexConfig()
+        cfg.actor.transport = "tcp"
+        cfg.actor.net_coalesce_wait_ms = -1.0
+        with pytest.raises(ValueError, match="net_coalesce_wait_ms"):
+            cfg.validate()
+        cfg = ApexConfig()                   # shm cannot use the layers
+        cfg.actor.net_codec = "zlib"
+        with pytest.raises(ValueError, match="transport=tcp"):
+            cfg.validate()
+
     def test_tcp_knob_validation(self):
         from ape_x_dqn_tpu.config import ApexConfig
 
@@ -533,6 +638,458 @@ class TestTransportBudgetPerHost:
         cfg.actor.net_conn_buf_bytes = 1024
         with pytest.raises(ValueError, match="net_conn_buf_bytes"):
             cfg.validate()
+
+
+class TestBatchCodec:
+    """The F_XPB container in isolation: bit-exact reconstruction, dedup
+    economics on n-step-overlapped chunks, codec honesty."""
+
+    def test_envelope_layout_mirrors_shm_ring(self):
+        """net.py re-declares the record envelope + APXT prefix so it
+        stays standalone-loadable; the layouts must never drift."""
+        from ape_x_dqn_tpu.runtime import net, shm_ring
+
+        assert net._XP_ENVELOPE.size == shm_ring._MSG.size
+        assert net._XP_ENVELOPE.format == shm_ring._MSG.format
+        assert net._APXT_PREFIX.size == shm_ring._APXT_PREFIX.size
+        assert net._APXT_MAGIC == shm_ring._APXT_MAGIC
+
+    def test_roundtrip_bit_exact_with_and_without_dedup(self):
+        recs = [_chunk_record(seed=s) for s in range(3)]
+        for dedup in (True, False):
+            body, _ = encode_batch(recs, dedup=dedup)
+            assert decode_batch(body) == recs
+
+    def test_dedup_halves_nstep_overlapped_chunks(self):
+        rec = _chunk_record(rows=16, n_step=3)
+        body, st = encode_batch([rec], dedup=True)
+        # 16 obs + 16 next_obs frames, 13 of them window-duplicates.
+        assert st["dedup_hits"] == 13
+        assert len(body) < 0.65 * len(rec)
+        # Identical records across the window dedup almost entirely.
+        body2, st2 = encode_batch([rec, rec], dedup=True)
+        assert st2["dedup_hits"] > st["dedup_hits"]
+        assert len(body2) < len(body) + 0.2 * len(rec)
+
+    def test_zlib_only_sticks_when_it_shrinks(self):
+        rng = np.random.default_rng(3)
+        incompressible = bytes(rng.integers(0, 255, 50_000, dtype=np.uint8))
+        p, st = encode_xpb_payload([incompressible], codec=CODEC_ZLIB,
+                                   dedup=False)
+        assert st["compressed"] is False and p[0] == CODEC_OFF
+        compressible = bytes(1000) * 50
+        p2, st2 = encode_xpb_payload([compressible], codec=CODEC_ZLIB,
+                                     dedup=False)
+        assert st2["compressed"] is True and p2[0] == CODEC_ZLIB
+        assert len(p2) < len(compressible) // 10
+        assert decode_xpb_payload(p2) == [compressible]
+
+    def test_codec_off_payload_never_compressed(self):
+        p, st = encode_xpb_payload([bytes(4096)], codec=CODEC_OFF,
+                                   dedup=False)
+        assert p[0] == CODEC_OFF and st["compressed"] is False
+
+
+class TestBatchAdversarial:
+    """The new encode layers' decode matrix: every malformation raises
+    (unit level) / counts torn + retires the connection (wire level) —
+    nothing invalid is EVER ingested."""
+
+    def test_ref_outside_window_raises(self):
+        rec = b"x" * 500
+        body, _ = encode_batch([rec], dedup=False)
+        # Hand-craft a batch whose ref reaches past the decoded stream.
+        import struct as _s
+
+        evil = (_s.pack("<I", 1) + _s.pack("<I", 600)
+                + _s.pack("<BI", 0, 500) + rec
+                + _s.pack("<BIQ", 1, 100, 450))  # 450+100 > 500 decoded
+        with pytest.raises(ValueError, match="window"):
+            decode_batch(evil)
+
+    def test_length_table_mismatch_raises(self):
+        import struct as _s
+
+        short = _s.pack("<I", 1) + _s.pack("<I", 100) \
+            + _s.pack("<BI", 0, 40) + b"y" * 40
+        with pytest.raises(ValueError, match="shorter"):
+            decode_batch(short)
+        over = _s.pack("<I", 1) + _s.pack("<I", 10) \
+            + _s.pack("<BI", 0, 40) + b"y" * 40
+        with pytest.raises(ValueError, match="overrun"):
+            decode_batch(over)
+
+    def test_bad_op_and_truncations_raise(self):
+        import struct as _s
+
+        with pytest.raises(ValueError):
+            decode_batch(b"")                       # no count
+        with pytest.raises(ValueError, match="length table"):
+            decode_batch(_s.pack("<I", 4) + b"\x00" * 4)
+        with pytest.raises(ValueError, match="op"):
+            decode_batch(_s.pack("<I", 1) + _s.pack("<I", 1) + b"\x07")
+        with pytest.raises(ValueError, match="truncated literal"):
+            decode_batch(_s.pack("<I", 1) + _s.pack("<I", 50)
+                         + _s.pack("<BI", 0, 50) + b"z" * 10)
+
+    def test_decompress_fault_raises(self):
+        good, st = encode_xpb_payload([bytes(1000) * 20], codec=CODEC_ZLIB,
+                                      dedup=False)
+        assert st["compressed"]
+        # Deflate streams carry padding/unused-table bits, so one flip
+        # can be semantically invisible — the CONTRACT is that every flip
+        # either raises or decodes bit-identical (harmless): corrupt
+        # output can never come back verified.
+        raised = 0
+        for pos in range(1, len(good)):
+            bad = bytearray(good)
+            bad[pos] ^= 0x10
+            try:
+                out = decode_xpb_payload(bytes(bad))
+            except ValueError:
+                raised += 1
+                continue
+            assert out == [bytes(1000) * 20], f"corrupt decode at {pos}"
+        assert raised >= 1                  # consequential flips detected
+        with pytest.raises(ValueError, match="truncated"):
+            decode_xpb_payload(good[:len(good) // 2])   # truncated stream
+        with pytest.raises(ValueError, match="negotiated off"):
+            decode_xpb_payload(good, allow_zlib=False)
+        with pytest.raises(ValueError, match="unknown codec"):
+            decode_xpb_payload(b"\x07" + good[1:])
+
+    def test_truncated_coalesced_frame_mid_record_is_torn(self):
+        """A batch frame cut mid-record at disconnect: the committed
+        frame before it delivers, the torn batch never yields ANY of its
+        records, the tear is counted."""
+        tr = NetTransport(codec="zlib")
+        try:
+            ch = tr.make_channel(0, 0)
+            s = _connect_raw(tr, version=_NET_VERSION_EXT,
+                             ext=_HELLO_EXT.pack(CODEC_ZLIB, 1))
+            _pump_until(tr, lambda: ch.connected)
+            whole, _ = encode_xpb_payload([b"first-record"], dedup=False)
+            s.sendall(frame_bytes(F_XPB, 1, [whole]))
+            batch2, _ = encode_xpb_payload(
+                [b"second-record", b"third-record"], dedup=False
+            )
+            torn = frame_bytes(F_XPB, 2, [batch2])
+            s.sendall(torn[:len(torn) - 7])   # cut inside the last record
+            time.sleep(0.2)
+            s.close()
+            got = []
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                rec = ch.read_next()
+                if rec is not None:
+                    got.append(rec)
+                elif not ch.connected:
+                    break
+                time.sleep(0.01)
+            assert got == [b"first-record"]
+            assert ch.torn_tail() and tr.stats()["torn_frames"] >= 1
+        finally:
+            tr.close()
+
+    def test_bitflip_inside_compressed_payload_torn_and_retired(self):
+        """The frame CRC covers the ENCODED bytes; a flip the sampled
+        window missed still dies in zlib's adler32 — counted torn,
+        nothing ingested, connection retired."""
+        tr = NetTransport(codec="zlib")
+        try:
+            ch = tr.make_channel(0, 0)
+            s = _connect_raw(tr, version=_NET_VERSION_EXT,
+                             ext=_HELLO_EXT.pack(CODEC_ZLIB, 1))
+            _pump_until(tr, lambda: ch.connected)
+            payload, st = encode_xpb_payload(
+                [bytes(8192) * 4, bytes(range(256)) * 64], dedup=False,
+                codec=CODEC_ZLIB,
+            )
+            assert st["compressed"]
+            # Pick a flip the codec layer provably rejects (deflate
+            # padding bits make some flips invisible — harmless ones).
+            evil = None
+            for pos in range(len(payload) // 2, len(payload)):
+                cand = bytearray(payload)
+                cand[pos] ^= 0x20
+                try:
+                    decode_xpb_payload(bytes(cand))
+                except ValueError:
+                    evil = bytes(cand)
+                    break
+            assert evil is not None
+            # Re-framed with a CORRECT crc over the flipped bytes: the
+            # frame layer verifies clean, the codec layer must catch it.
+            s.sendall(frame_bytes(F_XPB, 1, [evil]))
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                assert ch.read_next() is None      # nothing EVER delivered
+                if tr.stats()["torn_frames"] >= 1:
+                    break
+                time.sleep(0.01)
+            assert tr.stats()["torn_frames"] >= 1
+            assert ch.committed == 0 and not ch.connected
+            s.close()
+        finally:
+            tr.close()
+
+    def test_dedup_ref_out_of_window_torn_on_the_wire(self):
+        import struct as _s
+
+        tr = NetTransport()
+        try:
+            ch = tr.make_channel(0, 0)
+            s = _connect_raw(tr, version=_NET_VERSION_EXT,
+                             ext=_HELLO_EXT.pack(CODEC_OFF, 1))
+            _pump_until(tr, lambda: ch.connected)
+            evil_body = (_s.pack("<I", 1) + _s.pack("<I", 64)
+                         + _s.pack("<BIQ", 1, 64, 0))  # ref, empty window
+            s.sendall(frame_bytes(F_XPB, 1, [b"\x00" + evil_body]))
+            _pump_until(tr, lambda: (ch.read_next(), False)[1]
+                        or tr.stats()["torn_frames"] >= 1)
+            assert ch.committed == 0 and not ch.connected
+            s.close()
+        finally:
+            tr.close()
+
+    def test_codec_mismatch_hello_rejected(self):
+        """A writer proposing zlib against an off-codec transport is
+        refused AT THE HANDSHAKE — no framing state, no channel adopt."""
+        tr = NetTransport(codec="off")
+        try:
+            ch = tr.make_channel(0, 0)
+            s = _connect_raw(tr, version=_NET_VERSION_EXT,
+                             ext=_HELLO_EXT.pack(CODEC_ZLIB, 1))
+            _pump_until(tr, lambda: tr.rejects >= 1)
+            assert tr.codec_rejects == 1
+            assert not ch.connected
+            # An off-codec v2 hello against the same transport is fine.
+            s2 = _connect_raw(tr, version=_NET_VERSION_EXT,
+                              ext=_HELLO_EXT.pack(CODEC_OFF, 1))
+            _pump_until(tr, lambda: ch.connected)
+            assert tr.stats()["codec_rejects"] == 1
+            s.close()
+            s2.close()
+        finally:
+            tr.close()
+
+    def test_compressed_batch_on_off_negotiated_connection_torn(self):
+        """Even a VALID zlib batch is a protocol violation on a
+        connection whose hello negotiated codec off."""
+        tr = NetTransport(codec="zlib")
+        try:
+            ch = tr.make_channel(0, 0)
+            s = _connect_raw(tr, version=_NET_VERSION_EXT,
+                             ext=_HELLO_EXT.pack(CODEC_OFF, 1))
+            _pump_until(tr, lambda: ch.connected)
+            payload, st = encode_xpb_payload([bytes(4096) * 8],
+                                             codec=CODEC_ZLIB, dedup=False)
+            assert st["compressed"]
+            s.sendall(frame_bytes(F_XPB, 1, [payload]))
+            _pump_until(tr, lambda: (ch.read_next(), False)[1]
+                        or tr.stats()["torn_frames"] >= 1)
+            assert ch.committed == 0
+            s.close()
+        finally:
+            tr.close()
+
+
+class TestWireEfficiencyEndToEnd:
+    def _writer(self, tr, **wire):
+        spec = {"host": "127.0.0.1", "port": tr.port, "token": tr.token,
+                "wid": 0, "attempt": 0, **wire}
+        return NetWriter(spec)
+
+    def test_coalesced_dedup_zlib_bit_exact_and_ratio(self):
+        """The full stack on: many records per wire frame, bit-exact
+        reconstruction, wire bytes < logical bytes, occupancy > 1."""
+        tr = NetTransport(codec="zlib")
+        try:
+            ch = tr.make_channel(0, 0)
+            w = self._writer(tr, codec="zlib", coalesce=4 << 20,
+                             coalesce_wait_ms=10_000.0, dedup=True)
+            recs = [_chunk_record(seed=s) for s in range(4)]
+            parts_sets = [[r] for r in recs]
+            for ps in parts_sets:
+                assert w.write(ps, timeout=5)
+            assert w.flush(timeout=5)
+            got = []
+            deadline = time.monotonic() + 5
+            while len(got) < 4 and time.monotonic() < deadline:
+                tr.pump()
+                rec = ch.read_next()
+                if rec is not None:
+                    got.append(rec)
+                else:
+                    time.sleep(0.005)
+            assert got == recs                     # bit-exact ingest
+            s = tr.stats()
+            assert s["torn_frames"] == 0
+            assert s["coalesced_frames_in"] == 1
+            assert s["records_per_frame"] == 4.0
+            assert s["logical_bytes_in"] == sum(len(r) for r in recs)
+            assert s["wire_over_logical"] < 1.0    # dedup+codec winning
+            assert s["codec_ms"] >= 0.0
+            assert w.records_written == 4 and w.flushes == 1
+            assert w.dedup_ref_bytes > 0
+            w.close()
+        finally:
+            tr.close()
+
+    def test_codec_off_coalesce_off_wire_bit_identical_to_v1(self):
+        """The acceptance pin: a default-spec writer puts EXACTLY the v1
+        bytes on the wire — v1 hello, one F_XP frame per record, same
+        header/crc arithmetic as before the wire-efficiency layers."""
+        import socket as socket_mod
+
+        srv = socket_mod.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        try:
+            w = NetWriter({"host": "127.0.0.1",
+                           "port": srv.getsockname()[1],
+                           "token": 77, "wid": 3, "attempt": 1})
+            payloads = [b"alpha-record", b"beta" * 600]
+            ok = []
+            import threading as _t
+
+            def _feed():
+                ok.append(all(w.write([p], timeout=5) for p in payloads))
+
+            th = _t.Thread(target=_feed)
+            th.start()
+            conn, _ = srv.accept()
+            conn.settimeout(5)
+            expect = _HELLO.pack(_NET_MAGIC, _NET_VERSION, 3, 1, 77) \
+                + frame_bytes(F_XP, 1, [payloads[0]]) \
+                + frame_bytes(F_XP, 2, [payloads[1]])
+            raw = b""
+            while len(raw) < len(expect):
+                raw += conn.recv(len(expect) - len(raw))
+            th.join(timeout=5)
+            assert ok == [True]
+            assert raw == expect
+            assert not w._features and w.flushes == 0
+            w.close()
+            conn.close()
+        finally:
+            srv.close()
+
+    def test_quantum_flush_and_close_flush(self):
+        """Records never rot in the coalescing buffer: an explicit
+        flush() pushes a partial batch, and close() flushes the rest."""
+        tr = NetTransport(codec="zlib")
+        try:
+            ch = tr.make_channel(0, 0)
+            w = self._writer(tr, codec="zlib", coalesce=64 << 20,
+                             coalesce_wait_ms=10_000.0)
+            assert w.write([b"sits-in-the-buffer"], timeout=5)
+            assert ch.read_next() is None
+            assert w.flush(timeout=5)
+            _pump_until(tr, lambda: ch.read_next() == b"sits-in-the-buffer")
+            assert w.write([b"flushed-at-close"], timeout=5)
+            w.close()
+            _pump_until(tr, lambda: ch.read_next() == b"flushed-at-close")
+        finally:
+            tr.close()
+
+    def test_auto_codec_gates_on_backpressure(self):
+        """net_codec=auto: raw until full_waits grows, compressed after,
+        raw again once the backpressure stays quiet."""
+        w = NetWriter({"host": "127.0.0.1", "port": 1, "token": 1,
+                       "wid": 0, "attempt": 0, "codec": "auto",
+                       "coalesce": 1 << 20})
+        assert w._effective_codec() == CODEC_OFF
+        w.full_waits += 3                  # kernel buffer pushed back
+        w._auto_update()
+        assert w._effective_codec() == CODEC_ZLIB
+        from ape_x_dqn_tpu.runtime.net import _AUTO_OFF_FLUSHES
+
+        for _ in range(_AUTO_OFF_FLUSHES):  # a long quiet spell
+            w._auto_update()
+        assert w._effective_codec() == CODEC_OFF
+        w.close()
+
+    def test_max_wait_flush_on_next_write(self):
+        tr = NetTransport()
+        try:
+            ch = tr.make_channel(0, 0)
+            w = self._writer(tr, coalesce=64 << 20, coalesce_wait_ms=1.0)
+            assert w.write([b"one"], timeout=5)
+            time.sleep(0.05)               # max-wait elapses
+            assert w.write([b"two"], timeout=5)   # triggers the flush
+            got = []
+            deadline = time.monotonic() + 5
+            while len(got) < 2 and time.monotonic() < deadline:
+                tr.pump()
+                rec = ch.read_next()
+                if rec is not None:
+                    got.append(rec)
+            assert got == [b"one", b"two"]
+            assert tr.stats()["coalesced_frames_in"] == 1
+            w.close()
+        finally:
+            tr.close()
+
+
+class TestPoolWireEfficiency:
+    """Pool-level: the config-driven wire layers feed replay ingest the
+    IDENTICAL decoded chunks, and the `net` section reports the ratio."""
+
+    def test_pool_ingest_bit_exact_under_codec_and_coalesce(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+        from ape_x_dqn_tpu.runtime.process_actors import ProcessActorPool
+        from ape_x_dqn_tpu.runtime.transport import connect_channel
+
+        cfg = ApexConfig()
+        cfg.network = "mlp"
+        cfg.env.name = "chain:6"
+        cfg.actor.mode = "process"
+        cfg.actor.transport = "tcp"
+        cfg.actor.net_codec = "zlib"
+        cfg.actor.net_coalesce_bytes = 1 << 20
+        cfg.actor.num_workers = 1
+        cfg.actor.num_actors = 2
+        cfg.validate()
+        pool = ProcessActorPool(cfg, num_workers=1, ring_bytes=1 << 16)
+        try:
+            pool._queues[0] = pool._ctx.Queue(maxsize=4)
+            pool._rings[0] = pool._transport.make_channel(0, 0)
+            spec = pool._transport.endpoint(pool._rings[0], 0, 0)
+            assert spec["codec"] == "zlib" and spec["coalesce"] == 1 << 20
+            w = connect_channel(spec)
+            rng = np.random.default_rng(11)
+            frames = rng.integers(0, 255, (7, 8, 8, 1), dtype=np.uint8)
+            arrays = {"prio": rng.random(4).astype(np.float32),
+                      "obs": frames[:4],
+                      "action": np.arange(4, dtype=np.int32),
+                      "reward": rng.normal(size=4).astype(np.float32),
+                      "discount": np.full(4, 0.97, np.float32),
+                      "next_obs": frames[3:]}
+            for seq in range(3):
+                assert w.write(
+                    encode_chunk_parts(XP, 20 + seq, 4, arrays), timeout=5
+                )
+            assert w.flush(timeout=5)
+            items = []
+            deadline = time.monotonic() + 5
+            while len(items) < 3 and time.monotonic() < deadline:
+                items.extend(pool.poll(max_items=8))
+                time.sleep(0.01)
+            assert len(items) == 3
+            for prio, trans in items:
+                np.testing.assert_array_equal(prio, arrays["prio"])
+                np.testing.assert_array_equal(trans.obs, arrays["obs"])
+                np.testing.assert_array_equal(trans.next_obs,
+                                              arrays["next_obs"])
+            net = pool.net_stats()
+            assert net["torn_frames"] == 0
+            assert net["frames_in"] == 3
+            assert net["coalesced_frames_in"] >= 1
+            assert net["wire_over_logical"] < 1.0
+            w.close()
+        finally:
+            pool.stop(join_timeout=1.0)
 
 
 class TestClockSkewClamp:

@@ -20,7 +20,14 @@ REAL process-actor pipeline (actor.transport=tcp, loopback):
   4. param fan-out over the same connections: published versions reach
      workers (param_version advances in worker stats), with per-push
      fan-out cost recorded on the `net` section;
-  5. stop cleanly; print a one-line JSON verdict.
+  5. WIRE-EFFICIENCY leg (ISSUE 10): the same pool transport surface
+     with `net_codec=zlib` + coalescing + frame dedup on — deterministic
+     trajectory chunks through a real NetWriter → hello-negotiated
+     connection → pool.poll, asserting BIT-EXACT ingest (every decoded
+     array equals its source) and a measured wire/logical ratio < 1.0,
+     with zero torn frames.  Runs in-process in ~a second (no extra jax
+     children), so the gate's time budget stands;
+  6. stop cleanly; print a one-line JSON verdict.
 
     python tools/net_smoke.py
 """
@@ -173,6 +180,9 @@ def main(argv=None) -> int:
                  "lineage-span-through-tcp-chunks")
         assert pipe._lineage.clock_skew_clamped == 0
         verdict["lineage_spans"] = pipe._lineage.completed_count
+
+        # -- 5: wire-efficiency leg (codec + coalesce + dedup) -------------
+        verdict["wire_leg"] = _wire_leg()
         verdict["ok"] = True
     finally:
         pipe.stop_event.set()
@@ -181,6 +191,101 @@ def main(argv=None) -> int:
         verdict["run_error"] = err[0]
     print(json.dumps(verdict))
     return 0 if verdict.get("ok") else 1
+
+
+def _wire_leg() -> dict:
+    """net_codec=zlib + coalescing + frame dedup on the pool's transport
+    surface: deterministic trajectory chunks (production n-step overlap)
+    through a real hello-negotiated connection into pool.poll — BIT-EXACT
+    ingest, wire/logical < 1.0, zero torn frames."""
+    import numpy as np
+
+    from ape_x_dqn_tpu.config import ApexConfig
+    from ape_x_dqn_tpu.runtime.process_actors import ProcessActorPool
+    from ape_x_dqn_tpu.runtime.shm_ring import XP, encode_chunk_parts
+    from ape_x_dqn_tpu.runtime.transport import connect_channel
+
+    cfg = ApexConfig()
+    cfg.network = "mlp"
+    cfg.env.name = "chain:6"
+    cfg.actor.mode = "process"
+    cfg.actor.transport = "tcp"
+    cfg.actor.net_codec = "zlib"
+    cfg.actor.net_coalesce_bytes = 1 << 20
+    cfg.actor.num_workers = 1
+    cfg.actor.num_actors = 2
+    cfg.obs.postmortem_dir = None
+    cfg.validate()
+    pool = ProcessActorPool(cfg, num_workers=1, ring_bytes=1 << 16)
+    try:
+        pool._queues[0] = pool._ctx.Queue(maxsize=4)
+        pool._rings[0] = pool._transport.make_channel(0, 0)
+        spec = pool._transport.endpoint(pool._rings[0], 0, 0)
+        assert spec["codec"] == "zlib" and spec["coalesce"] == 1 << 20
+        w = connect_channel(spec)
+        rng = np.random.default_rng(5)
+        rows, n = 16, 3
+        # Trajectory-shaped frames: static background + moving sprite,
+        # obs[i + n] == next_obs[i] — what the dedup window removes.
+        stream = np.repeat(
+            rng.integers(0, 255, (1, 24, 24, 1), dtype=np.uint8),
+            3 * rows + n, axis=0,
+        )
+        for i in range(stream.shape[0]):
+            y = (3 * i) % 16
+            stream[i, y:y + 8, :8] = rng.integers(
+                0, 255, (8, 8, 1), dtype=np.uint8
+            )
+        sent = []
+        for c in range(3):
+            arrays = {
+                "prio": (np.abs(rng.normal(size=rows)) + 0.1).astype(
+                    np.float32
+                ),
+                "obs": np.ascontiguousarray(
+                    stream[c * rows:c * rows + rows]
+                ),
+                "action": rng.integers(0, 4, (rows,), dtype=np.int32),
+                "reward": rng.normal(size=(rows,)).astype(np.float32),
+                "discount": np.full((rows,), 0.97, np.float32),
+                "next_obs": np.ascontiguousarray(
+                    stream[c * rows + n:c * rows + rows + n]
+                ),
+            }
+            sent.append(arrays)
+            assert w.write(
+                encode_chunk_parts(XP, 30 + c, rows, arrays), timeout=10
+            )
+        assert w.flush(timeout=10)
+        items = []
+        deadline = time.monotonic() + 30
+        while len(items) < 3 and time.monotonic() < deadline:
+            items.extend(pool.poll(max_items=8))
+            time.sleep(0.01)
+        assert len(items) == 3, f"only {len(items)}/3 chunks ingested"
+        for (prio, trans), arrays in zip(items, sent):
+            # Bit-exact ingest: every decoded array equals its source.
+            np.testing.assert_array_equal(prio, arrays["prio"])
+            for field in ("obs", "action", "reward", "discount",
+                          "next_obs"):
+                np.testing.assert_array_equal(
+                    getattr(trans, field), arrays[field]
+                )
+        net = pool.net_stats()
+        assert net["torn_frames"] == 0, net
+        assert net["frames_in"] == 3, net
+        assert net["coalesced_frames_in"] >= 1, net
+        assert net["wire_over_logical"] is not None
+        assert net["wire_over_logical"] < 1.0, net
+        w.close()
+        return {
+            "bit_exact_chunks": 3,
+            "wire_over_logical": net["wire_over_logical"],
+            "records_per_frame": net["records_per_frame"],
+            "codec_frames_in": net["codec_frames_in"],
+        }
+    finally:
+        pool.stop(join_timeout=5.0)
 
 
 def _run(pipe, err: list) -> None:

@@ -65,7 +65,7 @@ def snapshot_from_jsonl(path: str) -> dict:
     )
     out = {"learner": {k: last[k] for k in learner_keys if k in last}}
     for section in ("workers", "lineage", "xp_transport", "ckpt",
-                    "stage_us", "serving_net", "serving_router"):
+                    "stage_us", "net", "serving_net", "serving_router"):
         if section in last:
             out[section] = last[section]
     out["t"] = last.get("t")
@@ -154,6 +154,19 @@ def render(snap: dict) -> str:
             f"({ckpt.get('bases', 0)} bases) "
             f"last_stall {ckpt.get('last_stall_ms', 0)} ms  "
             f"skips {ckpt.get('inflight_skips', 0)}"
+        )
+    xnet = snap.get("net")
+    if xnet:
+        ratio = xnet.get("wire_over_logical")
+        lines.append(
+            f"-- xp net  conns {xnet.get('connections', 0)}"
+            f"/{xnet.get('expected', 0)}  "
+            f"{(xnet.get('bytes_in_per_s') or 0) / 1e6:8.1f} MB/s wire  "
+            f"ratio {ratio if ratio is not None else '-'}  "
+            f"rec/frame {xnet.get('records_per_frame', '-')}  "
+            f"codec {xnet.get('codec', 'off')} "
+            f"({xnet.get('codec_ms', 0)} ms)  "
+            f"torn {xnet.get('torn_frames', 0)}"
         )
     snet = snap.get("serving_net") or (snap.get("serving") or {}).get("net")
     if snet:

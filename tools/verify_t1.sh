@@ -36,8 +36,11 @@
 #         training steps, an injected partial frame is detected as torn
 #         and never ingested, the displaced worker reconnects and
 #         resumes, a SIGKILLed worker respawns onto a fresh connection,
-#         and param fan-out cost is recorded per push
-#         (tools/net_smoke.py).
+#         and param fan-out cost is recorded per push; then the
+#         wire-efficiency leg — net_codec=zlib + coalescing + frame
+#         dedup through a hello-negotiated connection into pool.poll,
+#         asserting BIT-EXACT ingest and wire/logical < 1.0 with zero
+#         torn frames (tools/net_smoke.py).
 # Gate 9: serving-net smoke — the network serving tier end to end: a
 #         2-replica fleet on ephemeral ports (router + delta param hub),
 #         a closed-loop client burst over real sockets, a hot param

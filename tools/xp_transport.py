@@ -69,6 +69,58 @@ def _make_arrays(wid: int, rows: int, obs_shape) -> Dict[str, np.ndarray]:
     }
 
 
+class _TrajChunker:
+    """TRAJECTORY-shaped chunk source: one continuing frame stream per
+    producer with the production n-step overlap (``obs[i + n] ==
+    next_obs[i]`` — the ~2x frame redundancy the replay dedup tier
+    measures at emission ratio ~1.02) and Atari-like content (static
+    background + a small moving sprite), so wire dedup/compression
+    measure what they would see from real actors instead of the
+    incompressible iid noise of ``_make_arrays`` (kept for the
+    shm-vs-queue section, where content cannot matter: every transport
+    memcpys the same byte count).  Each ``next()`` ADVANCES the stream —
+    consecutive chunks share only the n-step boundary frames, never
+    whole bodies — over a precomputed cycle long enough that no
+    coalescing window ever sees the same stream position twice."""
+
+    CYCLE = 509                 # prime >> any coalescing window, in frames
+
+    def __init__(self, wid: int, rows: int, obs_shape, n_step: int = 3):
+        rng = np.random.default_rng(wid)
+        self._rng = rng
+        self._rows = rows
+        self._n = n_step
+        h = int(obs_shape[0])
+        w = int(obs_shape[1]) if len(obs_shape) > 1 else 1
+        base = rng.integers(0, 255, obs_shape, dtype=np.uint8)
+        self._frames = np.repeat(base[None], self.CYCLE, axis=0)
+        sp = max(2, min(8, h // 4))
+        for i in range(self.CYCLE):         # the sprite walks the frame
+            y = (3 * i) % max(1, h - sp)
+            x = (5 * i) % max(1, w - sp)
+            self._frames[i, y:y + sp, x:x + sp] = rng.integers(
+                0, 255, self._frames[i, y:y + sp, x:x + sp].shape,
+                dtype=np.uint8,
+            )
+        self._pos = 0
+
+    def next(self) -> Dict[str, np.ndarray]:
+        rows, n, rng = self._rows, self._n, self._rng
+        idx = (self._pos + np.arange(rows + n)) % self.CYCLE
+        window = self._frames.take(idx, axis=0)   # fresh gather per chunk
+        self._pos = (self._pos + rows) % self.CYCLE
+        return {
+            "prio": (np.abs(rng.normal(size=rows)) + 0.1).astype(
+                np.float32
+            ),
+            "obs": np.ascontiguousarray(window[:rows]),
+            "action": rng.integers(0, 4, (rows,), dtype=np.int32),
+            "reward": rng.normal(size=(rows,)).astype(np.float32),
+            "discount": np.full((rows,), 0.97, np.float32),
+            "next_obs": np.ascontiguousarray(window[n:]),
+        }
+
+
 def _nice(n: int) -> None:
     """Production parity: worker processes run niced so the learner-side
     drain thread stays scheduled (config.ActorConfig.worker_nice) —
@@ -97,16 +149,20 @@ def _queue_producer(q, wid: int, rows: int, obs_shape, stop_evt,
 
 
 def _ring_producer(ring_name: str, capacity: int, wid: int, rows: int,
-                   obs_shape, stop_evt, nice: int = 10) -> None:
+                   obs_shape, stop_evt, nice: int = 10,
+                   traj: bool = False) -> None:
     """Chunks into the shm ring, the production encode path (version field
     carries the chunk seq so the barrage can validate per-chunk identity)."""
     _nice(nice)
     mod = load_shm_ring()
     ring = mod.ShmRing(capacity, name=ring_name, create=False)
-    arrays = _make_arrays(wid, rows, obs_shape)
+    chunker = _TrajChunker(wid, rows, obs_shape) if traj else None
+    arrays = _make_arrays(wid, rows, obs_shape) if not traj else None
     seq = 0
     try:
         while not stop_evt.is_set():
+            if chunker is not None:
+                arrays = chunker.next()
             parts = mod.encode_chunk_parts(mod.XP, seq, rows, arrays)
             if not ring.write(parts, should_stop=stop_evt.is_set):
                 break
@@ -116,19 +172,28 @@ def _ring_producer(ring_name: str, capacity: int, wid: int, rows: int,
 
 
 def _net_producer(host: str, port: int, token: int, wid: int, rows: int,
-                  obs_shape, stop_evt, nice: int = 10) -> None:
+                  obs_shape, stop_evt, nice: int = 10,
+                  traj: bool = False, wire: Optional[dict] = None) -> None:
     """Chunks over the TCP transport (runtime/net.py loaded by path),
     the production encode path — byte-identical frames to what a remote
-    worker on another host would send."""
+    worker on another host would send.  ``wire`` carries the
+    wire-efficiency spec fields (codec/coalesce/dedup); None keeps the
+    v1 one-frame-per-record wire."""
     _nice(nice)
     ring_mod = load_shm_ring()
     net_mod = load_net()
-    w = net_mod.NetWriter({"host": host, "port": port, "token": token,
-                           "wid": wid, "attempt": 0})
-    arrays = _make_arrays(wid, rows, obs_shape)
+    spec = {"host": host, "port": port, "token": token,
+            "wid": wid, "attempt": 0}
+    if wire:
+        spec.update(wire)
+    w = net_mod.NetWriter(spec)
+    chunker = _TrajChunker(wid, rows, obs_shape) if traj else None
+    arrays = _make_arrays(wid, rows, obs_shape) if not traj else None
     seq = 0
     try:
         while not stop_evt.is_set():
+            if chunker is not None:
+                arrays = chunker.next()
             parts = ring_mod.encode_chunk_parts(ring_mod.XP, seq, rows,
                                                 arrays)
             if not w.write(parts, should_stop=stop_evt.is_set):
@@ -150,11 +215,16 @@ def _spawn_all(ctx, target, argss):
 def run_transport_point(transport: str, workers: int, seconds: float,
                         rows: int = 64, obs_shape=(84, 84, 1),
                         ring_bytes: int = 4 << 20,
-                        ready_timeout: float = 180.0) -> dict:
+                        ready_timeout: float = 180.0,
+                        traj: bool = False,
+                        wire: Optional[dict] = None) -> dict:
     """One load point: ``workers`` producers → one consumer for a timed
     window.  The window starts only after EVERY producer has delivered at
     least one chunk (spawn/startup cost excluded — both transports pay
-    identical numpy-only child imports)."""
+    identical numpy-only child imports).  ``traj`` switches producers to
+    trajectory-shaped chunks (n-step overlap + compressible content);
+    ``wire`` enables the tcp wire-efficiency layers (codec/coalesce/
+    dedup spec fields) and adds wire-vs-logical byte accounting."""
     import multiprocessing as mp
 
     ctx = mp.get_context("spawn")
@@ -166,7 +236,7 @@ def run_transport_point(transport: str, workers: int, seconds: float,
     if transport == "shm_ring":
         rings = [mod.ShmRing(ring_bytes) for _ in range(workers)]
         procs = _spawn_all(ctx, _ring_producer, [
-            (r.name, ring_bytes, w, rows, obs_shape, stop_evt)
+            (r.name, ring_bytes, w, rows, obs_shape, stop_evt, 10, traj)
             for w, r in enumerate(rings)
         ])
     elif transport == "mp_queue":
@@ -180,11 +250,12 @@ def run_transport_point(transport: str, workers: int, seconds: float,
         # arithmetic (sweep budget / fleet width) at the default budget.
         net_tr = net_mod.NetTransport(
             drain_budget_per_conn=max(64 << 10, (64 << 20) // workers),
+            codec=(wire or {}).get("codec", "off"),
         )
         rings = [net_tr.make_channel(w, 0) for w in range(workers)]
         procs = _spawn_all(ctx, _net_producer, [
             ("127.0.0.1", net_tr.port, net_tr.token, w, rows, obs_shape,
-             stop_evt)
+             stop_evt, 10, traj, wire)
             for w in range(workers)
         ])
     else:
@@ -235,6 +306,7 @@ def run_transport_point(transport: str, workers: int, seconds: float,
                 time.sleep(0.0005)
         t0 = time.monotonic()
         chunks = rows_n = nbytes = 0
+        wire0 = net_tr.stats() if net_tr is not None else None
         while time.monotonic() - t0 < seconds:
             got = consume_once()
             if got is None:
@@ -244,6 +316,7 @@ def run_transport_point(transport: str, workers: int, seconds: float,
             nbytes += got[1]
             rows_n += got[2]
         elapsed = time.monotonic() - t0
+        wire1 = net_tr.stats() if net_tr is not None else None
     finally:
         stop_evt.set()
         for q in queues:  # unblock producers stuck in a full put
@@ -264,7 +337,7 @@ def run_transport_point(transport: str, workers: int, seconds: float,
             r.unlink()
         if net_tr is not None:
             net_tr.close()
-    return {
+    out = {
         "transport": transport,
         "workers": workers,
         "transitions_per_sec": round(rows_n / elapsed, 1),
@@ -273,6 +346,27 @@ def run_transport_point(transport: str, workers: int, seconds: float,
         "chunk_transitions": rows,
         "window_s": round(elapsed, 2),
     }
+    if wire0 is not None and wire1 is not None and rows_n:
+        # Wire-vs-logical byte economics over the timed window (the
+        # in-flight skew at the window edges is one coalesced frame per
+        # producer — noise at multi-second windows).
+        wire_b = wire1["bytes_in"] - wire0["bytes_in"]
+        logical_b = wire1["logical_bytes_in"] - wire0["logical_bytes_in"]
+        out["wire"] = {
+            "codec": (wire or {}).get("codec", "off"),
+            "coalesce_bytes": (wire or {}).get("coalesce", 0),
+            "dedup": bool((wire or {}).get("dedup", False)),
+            "wire_bytes_per_transition": round(wire_b / rows_n, 1),
+            "logical_bytes_per_transition": round(logical_b / rows_n, 1),
+            "wire_over_logical": (
+                round(wire_b / logical_b, 4) if logical_b else None
+            ),
+            "records_per_frame": wire1["records_per_frame"],
+            "codec_decode_ms": round(
+                wire1["codec_ms"] - wire0["codec_ms"], 1
+            ),
+        }
+    return out
 
 
 def run_transport_bench(workers_list: Sequence[int] = (4, 16, 64),
@@ -305,33 +399,80 @@ def run_transport_bench(workers_list: Sequence[int] = (4, 16, 64),
 
 def run_net_bench(workers_list: Sequence[int] = (4, 16, 64),
                  seconds: float = 3.0, rows: int = 64,
-                 obs_shape=(84, 84, 1), ring_bytes: int = 4 << 20) -> dict:
-    """``xp_net``: shm ring vs TCP-loopback at each fleet width — what
-    leaving /dev/shm for a socket actually costs on one host (the
-    cross-host transport's upper bound: loopback pays the framing, crc,
-    kernel socket path and per-frame copies, but no wire latency)."""
+                 obs_shape=(84, 84, 1), ring_bytes: int = 4 << 20,
+                 coalesce_bytes: int = 2 << 20) -> dict:
+    """``xp_net``: shm ring vs TCP-loopback vs TCP with the
+    wire-efficiency layers (coalesce + in-window frame dedup + zlib), at
+    each fleet width — what leaving /dev/shm costs, and what the byte
+    economy buys back.  ALL legs feed trajectory-shaped chunks (n-step
+    frame overlap + Atari-like compressible content — matched settings),
+    so the shm/tcp comparison is content-identical and the wire legs see
+    the redundancy real actors emit."""
     points = []
     for w in workers_list:
         shm = run_transport_point("shm_ring", w, seconds, rows, obs_shape,
-                                  ring_bytes=ring_bytes)
+                                  ring_bytes=ring_bytes, traj=True)
         tcp = run_transport_point("tcp_loopback", w, seconds, rows,
-                                  obs_shape, ring_bytes=ring_bytes)
+                                  obs_shape, ring_bytes=ring_bytes,
+                                  traj=True)
+        ded = run_transport_point(
+            "tcp_loopback", w, seconds, rows, obs_shape,
+            ring_bytes=ring_bytes, traj=True,
+            wire={"codec": "off", "coalesce": coalesce_bytes,
+                  "dedup": True},
+        )
+        eff = run_transport_point(
+            "tcp_loopback", w, seconds, rows, obs_shape,
+            ring_bytes=ring_bytes, traj=True,
+            wire={"codec": "zlib", "coalesce": coalesce_bytes,
+                  "dedup": True},
+        )
         base = max(tcp["transitions_per_sec"], 1e-9)
+        base_ded = max(ded["transitions_per_sec"], 1e-9)
+        base_eff = max(eff["transitions_per_sec"], 1e-9)
+        plain_bpt = tcp.get("wire", {}).get("wire_bytes_per_transition")
+        ded_bpt = ded.get("wire", {}).get("wire_bytes_per_transition")
+        eff_bpt = eff.get("wire", {}).get("wire_bytes_per_transition")
         points.append({
             "workers": w,
             "shm_ring": shm,
             "tcp_loopback": tcp,
+            "tcp_dedup": ded,
+            "tcp_wire_eff": eff,
             "shm_over_tcp": round(shm["transitions_per_sec"] / base, 2),
+            "shm_over_tcp_dedup": round(
+                shm["transitions_per_sec"] / base_ded, 2
+            ),
+            "shm_over_tcp_wire_eff": round(
+                shm["transitions_per_sec"] / base_eff, 2
+            ),
+            "wire_bytes_reduction_x_dedup": (
+                round(plain_bpt / ded_bpt, 2)
+                if plain_bpt and ded_bpt else None
+            ),
+            "wire_bytes_reduction_x": (
+                round(plain_bpt / eff_bpt, 2)
+                if plain_bpt and eff_bpt else None
+            ),
         })
     return {
         "points": points,
         "chunk_transitions": rows,
         "obs_shape": list(obs_shape),
+        "wire_eff": {"codec": "zlib", "coalesce_bytes": coalesce_bytes,
+                     "dedup": True},
         "note": (
             "N producer processes -> 1 consumer; identical CRC-framed "
-            "APXT records both ways (shm ring vs runtime/net.py TCP "
-            "frames over loopback); timed window starts after every "
-            "producer's first chunk; host-only (no jax in any process)"
+            "APXT records on every leg (shm ring vs runtime/net.py TCP "
+            "loopback: plain, coalesce+dedup, coalesce+dedup+zlib); "
+            "trajectory-shaped chunks (obs[i+n]==next_obs[i], static "
+            "background + moving sprite) on every leg — matched "
+            "settings; timed window starts after every producer's first "
+            "chunk; host-only (no jax in any process).  NB loopback on "
+            "a 1-core driver VM prices CPU, not the wire: the codec leg "
+            "trades CPU it doesn't have for bytes that are free there — "
+            "a real cross-host link inverts that trade (net_codec=auto "
+            "is the arbiter)"
         ),
     }
 
