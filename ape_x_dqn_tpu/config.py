@@ -145,6 +145,18 @@ class ActorConfig:
     # workers an unthrottled start piles every child's jax import onto the
     # host at once.  0 = spawn back-to-back.
     spawn_stagger_s: float = 0.0
+    # Remote-worker slots (tcp backend; tools/host_join.py).  The pool
+    # reserves this many extra worker ids beyond num_workers — channels
+    # pre-registered on the transport, actor slices carved from the SAME
+    # global partition — and publishes a join spec so one command on
+    # another host attaches that host's workers to this run.  The learner
+    # never spawns or supervises them: a dead remote worker is a quiet
+    # channel (its host's launcher owns respawn), never a pool fatal.
+    remote_workers: int = 0
+    # Where the join spec lands (atomic tmp+rename JSON: endpoint specs +
+    # the full run config + the per-run token).  Required non-empty when
+    # remote_workers > 0; host_join.py reads it.
+    remote_join_path: str = ""
     # Floor between a worker's death and its respawn, enforced by
     # ProcessActorPool.supervise() even when no supervisor policy is
     # attached: a worker whose env crashes deterministically at startup
@@ -282,6 +294,36 @@ class ReplayConfig:
     # evictor wakes past high x budget and trims to low x budget.
     spill_watermark_high: float = 1.0
     spill_watermark_low: float = 0.9
+    # --- replay as a service (replay/service.py) ---
+    # "attach" replaces the in-process replay with a retrying RPC client
+    # against a sharded replay fleet: sample/add/update-priorities become
+    # framed RPCs over the runtime/net.py wire discipline, the learner
+    # survives a shard dying (it keeps training on the surviving shards,
+    # priority write-backs to the dead one buffer last-write-wins and
+    # flush on recovery), and shards own their own checkpoint chains.
+    # "off" (default): the replay lives in the learner's address space,
+    # exactly as before.
+    service_mode: str = "off"
+    # Path to the fleet's endpoints file (written atomically by
+    # ReplayServiceFleet; re-read by the client when a shard moves after
+    # a respawn).  Required non-empty in attach mode.
+    service_endpoints: str = ""
+    # RPC payload codec — the wire-efficiency layers carried through:
+    # add/sample bodies are F_XPB-encoded (in-window frame dedup + zlib,
+    # negotiated at the hello exactly like the experience plane).
+    service_codec: str = "zlib"
+    service_dedup: bool = True
+    # Per-request deadline: a request not answered within this (across
+    # reconnects and whole-request retries) raises the typed
+    # ReplayShardUnavailable and the client routes around the shard.
+    service_request_timeout_s: float = 10.0
+    # Down-shard probe cadence (the client's background recovery loop:
+    # re-resolve the endpoint, cheap digest probe, flush buffered
+    # priority write-backs on success).
+    service_probe_interval_s: float = 0.5
+    # Fleet width for the service-side launcher (replay/service.py CLI /
+    # tools; the client takes its shard map from the endpoints file).
+    service_shards: int = 2
 
 
 @dataclasses.dataclass
@@ -456,6 +498,22 @@ class ChaosConfig:
     # Per-env-step latency injected inside worker processes (mean ms,
     # seeded jitter) — the slow-env scenario.
     env_latency_ms: float = 0.0
+    # --- RPC-plane chaos (replay/service.py shards) ---
+    # Mean per-request service delay (ms, seeded +/-50% jitter) injected
+    # shard-side before the request executes — the slow-replay scenario
+    # the client's deadline/backoff discipline exists for.
+    rpc_delay_ms: float = 0.0
+    # Probability a well-framed request is silently dropped shard-side
+    # (no reply — the lost-reply shape that forces the client's
+    # whole-request retry and the at-most-once add dedup).  Seeded.
+    rpc_drop_rate: float = 0.0
+    # SIGKILL one fleet shard (seeded choice) when the driver's step
+    # counter first crosses this value — the deterministic mid-run
+    # shard-death drill (ReplayServiceFleet.maybe_kill_at_step).  0 off.
+    kill_shard_at_step: int = 0
+    # Scheduled shard kills on the chaos monkey's seeded timeline
+    # (attach(replay_fleet=...)); 0 disables the kind.
+    kill_shard_interval_s: float = 0.0
 
     def validate_section(self) -> list:
         nonneg = [
@@ -470,9 +528,19 @@ class ChaosConfig:
             ("shm_fill_hold_s", self.shm_fill_hold_s),
             ("env_latency_ms", self.env_latency_ms),
         ]
+        nonneg += [
+            ("rpc_delay_ms", self.rpc_delay_ms),
+            ("kill_shard_interval_s", self.kill_shard_interval_s),
+        ]
         return [
             (v >= 0.0, f"chaos.{k} must be >= 0") for k, v in nonneg
-        ] + [(self.shm_fill_bytes >= 0, "chaos.shm_fill_bytes must be >= 0")]
+        ] + [
+            (self.shm_fill_bytes >= 0, "chaos.shm_fill_bytes must be >= 0"),
+            (0.0 <= self.rpc_drop_rate <= 1.0,
+             "chaos.rpc_drop_rate must be in [0, 1]"),
+            (self.kill_shard_at_step >= 0,
+             "chaos.kill_shard_at_step must be >= 0"),
+        ]
 
 
 @dataclasses.dataclass
@@ -617,6 +685,42 @@ class ApexConfig:
             (0.0 < r.spill_watermark_low <= r.spill_watermark_high <= 1.0,
              "replay spill watermarks must satisfy "
              "0 < low <= high <= 1"),
+            (r.service_mode in ("off", "attach"),
+             f"unknown replay.service_mode: {r.service_mode}"),
+            (r.service_mode == "off" or r.service_endpoints,
+             "replay.service_mode=attach requires replay.service_endpoints "
+             "(the fleet's endpoints file)"),
+            (r.service_codec in ("off", "zlib"),
+             f"unknown replay.service_codec: {r.service_codec}"),
+            (r.service_request_timeout_s > 0.0,
+             "replay.service_request_timeout_s must be > 0"),
+            (r.service_probe_interval_s > 0.0,
+             "replay.service_probe_interval_s must be > 0"),
+            (r.service_shards >= 1, "replay.service_shards must be >= 1"),
+            (r.service_mode == "off"
+             or not (r.dedup or r.frame_compression
+                     or r.hot_frame_budget_bytes or l.device_replay),
+             "replay.service_mode=attach hosts a plain PrioritizedReplay "
+             "per shard — dedup / frame_compression / hot_frame_budget / "
+             "device_replay stay learner-local features"),
+            (r.service_mode == "off" or not l.checkpoint_incremental,
+             "replay.service_mode=attach is incompatible with "
+             "learner.checkpoint_incremental: the shards own the replay's "
+             "checkpoint chains (the learner's state leg is unaffected)"),
+            (a.remote_workers >= 0,
+             "actor.remote_workers must be >= 0"),
+            (a.remote_workers == 0
+             or (a.mode == "process" and a.transport == "tcp"),
+             "actor.remote_workers requires actor.mode=process and "
+             "actor.transport=tcp (remote workers dial the experience "
+             "listener back)"),
+            (a.remote_workers == 0 or a.remote_join_path,
+             "actor.remote_workers > 0 requires actor.remote_join_path "
+             "(where the join spec for tools/host_join.py lands)"),
+            (a.mode != "process"
+             or a.num_actors >= a.num_workers + a.remote_workers,
+             "actor.num_actors must cover local + remote workers in "
+             "process mode"),
             (0.0 <= r.is_exponent <= 1.0, "replay.is_exponent must be in [0, 1]"),
             (self.network in ("conv", "nature", "mlp"),
              f"unknown network kind: {self.network}"),

@@ -132,6 +132,43 @@ def pick_chunk(inc_dir: str, rng: Optional[random.Random] = None,
     return os.path.join(inc_dir, rng.choice(chunks))
 
 
+class RpcChaos:
+    """Seeded fault injection for the replay-service RPC plane
+    (replay/service.py — config ``chaos.rpc_delay_ms`` /
+    ``chaos.rpc_drop_rate``).
+
+    Installed shard-side: ``delay_s()`` is consulted before every request
+    executes (mean ``delay_ms`` with ±50% seeded jitter — sleeping the
+    shard's pump thread IS the fault: every queued request behind it
+    waits too, the slow-replay shape the client's deadline exists for);
+    ``drop()`` decides whether a well-framed request is silently
+    discarded (no reply — the lost-reply shape that forces the client's
+    whole-request retry and proves the at-most-once add dedup).  Both
+    streams are pure functions of the seed, so a failing run reproduces.
+    """
+
+    def __init__(self, delay_ms: float = 0.0, drop_rate: float = 0.0,
+                 seed: int = 0):
+        self.delay_ms = float(delay_ms)
+        self.drop_rate = float(drop_rate)
+        self._rng = random.Random(seed ^ 0x69C)
+        self.delays = 0
+        self.drops = 0
+
+    def delay_s(self) -> float:
+        if self.delay_ms <= 0:
+            return 0.0
+        self.delays += 1
+        return self.delay_ms * (0.5 + self._rng.random()) / 1e3
+
+    def drop(self) -> bool:
+        if self.drop_rate <= 0:
+            return False
+        hit = self._rng.random() < self.drop_rate
+        self.drops += int(hit)
+        return hit
+
+
 class SlowEnv:
     """Env wrapper injecting seeded per-step latency (the slow-emulator
     scenario).  Delegates everything else to the wrapped env."""
@@ -209,7 +246,7 @@ class ChaosMonkey:
     """
 
     KINDS = ("kill", "sigstop", "torn_record", "corrupt_chunk",
-             "stuck_stager", "shm_fill")
+             "stuck_stager", "shm_fill", "kill_shard")
 
     def __init__(self, cfg, registry=None, emit=None,
                  horizon_s: float = 3600.0):
@@ -226,6 +263,7 @@ class ChaosMonkey:
         self._rng = random.Random(int(cfg.seed) ^ 0xC4405)
         self.schedule = self._build_schedule(float(horizon_s))
         self._pool = None
+        self._replay_fleet = None   # ReplayServiceFleet (kill_shard kind)
         self._ckpt_dirs: List[str] = []
         self._stager_stall = threading.Event()
         self._filler = ShmFiller()
@@ -243,6 +281,7 @@ class ChaosMonkey:
             "corrupt_chunk": self.cfg.corrupt_chunk_interval_s,
             "stuck_stager": self.cfg.stuck_stager_interval_s,
             "shm_fill": self.cfg.shm_fill_interval_s,
+            "kill_shard": getattr(self.cfg, "kill_shard_interval_s", 0.0),
         }
         events: List[tuple] = []
         for kind in self.KINDS:  # fixed order: determinism
@@ -260,10 +299,13 @@ class ChaosMonkey:
 
     # -- wiring ------------------------------------------------------------
 
-    def attach(self, pool=None, ckpt_dirs=None) -> "ChaosMonkey":
+    def attach(self, pool=None, ckpt_dirs=None,
+               replay_fleet=None) -> "ChaosMonkey":
         self._pool = pool if pool is not None else self._pool
         if ckpt_dirs:
             self._ckpt_dirs = list(ckpt_dirs)
+        if replay_fleet is not None:
+            self._replay_fleet = replay_fleet
         return self
 
     def stager_stalled(self) -> bool:
@@ -353,6 +395,8 @@ class ChaosMonkey:
                 return self._do_stuck_stager()
             if kind == "shm_fill":
                 return self._do_shm_fill()
+            if kind == "kill_shard":
+                return self._do_kill_shard()
         except Exception as e:  # noqa: BLE001 — a failed injection is data
             return self._record(
                 {"fault": kind, "failed": f"{type(e).__name__}: {e}"}
@@ -415,6 +459,16 @@ class ChaosMonkey:
         self._stop.wait(hold)
         self._stager_stall.clear()
         return self._record({"fault": "stuck_stager", "hold_s": hold})
+
+    def _do_kill_shard(self) -> Optional[dict]:
+        """SIGKILL one live replay-service shard (seeded victim) — the
+        mid-run shard-death drill the fleet's respawn + checkpoint-chain
+        recovery exists for (replay/service.py)."""
+        fleet = self._replay_fleet
+        if fleet is None:
+            return self._record({"fault": "kill_shard",
+                                 "skipped": "no replay fleet attached"})
+        return self._record(fleet.kill_random(rng=self._rng))
 
     def _do_shm_fill(self) -> dict:
         rec = self._filler.fill(int(self.cfg.shm_fill_bytes))

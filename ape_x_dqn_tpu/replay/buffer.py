@@ -344,6 +344,34 @@ class PrioritizedReplay:
             is_weights=weights,
         )
 
+    def sample_with_mass(
+        self,
+        batch_size: int,
+        rng: Optional[np.random.Generator] = None,
+    ) -> tuple:
+        """(transition, indices, mass, total_mass, size) — the raw
+        proportional sample WITHOUT the IS-weight arithmetic, for callers
+        that normalize globally (the replay service's sharded sample:
+        each shard returns its slots' p^α masses and its own total, the
+        learner-side client folds every shard's total into the global
+        denominator — replay/service.py)."""
+        rng = rng or np.random.default_rng()
+        with self._lock:
+            size = min(self._count, self.capacity)
+            if size == 0:
+                raise ValueError("cannot sample from an empty replay")
+            idx = self._tree.sample_stratified(batch_size, rng)
+            mass = self._tree.get(idx)
+            total = self._tree.total
+            transition = NStepTransition(
+                obs=self._obs.get(idx),
+                action=self._action[idx].copy(),
+                reward=self._reward[idx].copy(),
+                discount=self._discount[idx].copy(),
+                next_obs=self._next_obs.get(idx),
+            )
+        return transition, idx.astype(np.int64), mass, float(total), size
+
     def update_priorities(self, indices: np.ndarray, priorities: np.ndarray) -> None:
         """Learner priority feedback (reference ``set_priorities``,
         replay.py:32 — here per-transition and O(B log N)).
@@ -434,6 +462,37 @@ class PrioritizedReplay:
         with self._lock:
             m = self._tree.max_priority()
         return float(m ** (1.0 / self.alpha)) if m > 0 else 1.0
+
+    def digest(self, with_crc: bool = True) -> dict:
+        """Content fingerprint for bit-exact recovery proofs (the replay
+        service's ``state_digest`` RPC): counters, total p^α mass, and —
+        with ``with_crc`` — a crc32 over every live column including the
+        materialized frames.  The crc is an O(size) scan (the cheap
+        counter-only form is what liveness probes use); two replays with
+        equal digests hold bit-identical sampleable state."""
+        import struct as _struct
+
+        with self._lock:
+            size = min(self._count, self.capacity)
+            out = {
+                "count": int(self._count),
+                "cursor": int(self._cursor),
+                "size": int(size),
+                "total_mass": float(self._tree.total),
+                "crc": 0,
+            }
+            if not with_crc:
+                return out
+            idx = np.arange(size)
+            c = zlib.crc32(_struct.pack("<qq", self._count, self._cursor))
+            for arr in (
+                self._action[:size], self._reward[:size],
+                self._discount[:size], self._tree.get(idx),
+                self._obs.get(idx), self._next_obs.get(idx),
+            ):
+                c = zlib.crc32(np.ascontiguousarray(arr).tobytes(), c)
+            out["crc"] = int(c)
+            return out
 
     # -- snapshot (checkpointing) ----------------------------------------
 

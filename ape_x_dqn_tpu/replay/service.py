@@ -1,0 +1,1867 @@
+"""Replay as a service — a fault-tolerant sharded replay fleet.
+
+Horgan et al. 2018 is explicit that the CENTRAL REPLAY is the scaling
+bottleneck of Ape-X; every landed piece of this repo (CRC-framed net
+transport, tiered replay, delta param fan-out, the supervisor policy
+tier) stops one step short of the architecture's actual shape: N learner
+processes sampling one shared replay fleet.  What was missing is the
+robustness layer that makes a REMOTE replay usable — today a learner's
+sample path cannot survive its replay process dying, because the replay
+lives in the learner's address space.  This module is that layer:
+
+  * **Shard servers** (:class:`ReplayShardServer`): a replay-hosting
+    process speaking framed RPCs (``sample`` / ``add`` /
+    ``update_priorities`` / ``state_digest`` / ``stats``) over the
+    runtime/net.py frame discipline (``u32 len | u32 crc | i64 seq |
+    u8 kind``).  Torn, bitflipped, oversize and out-of-seq frames are
+    counted and NEVER decoded — the connection retires, exactly the
+    experience plane's adversarial-decode contract.  Ingest is
+    dedup-aware: add/sample bodies are F_XPB-encoded (in-window frame
+    dedup + negotiated zlib — ``encode_xpb_payload``), so PR 10's
+    0.63 KB/transition wire economy carries through to the replay RPC.
+  * **Sharding by slot range**: the global slot space ``[0, capacity)``
+    splits into equal ranges, one plain :class:`PrioritizedReplay` per
+    shard; clients map local↔global by the shard's base offset, adds
+    route round-robin over healthy shards, priority updates route by
+    ``index // shard_capacity``.
+  * **Retrying clients** (:class:`ShardClient` per shard,
+    :class:`ShardedReplayClient` over the fleet): per-request deadline,
+    jittered exponential backoff, whole-request retry across reconnects
+    with the ServingClient discipline (backoff resets ONLY on a verified
+    reply), and graceful degradation — while a shard is down the learner
+    keeps sampling/adding against the survivors, priority write-backs to
+    the dead shard buffer last-write-wins and flush on recovery, and the
+    failure surface is the typed :class:`ReplayShardUnavailable` plus a
+    degraded ``replay_svc`` health component, never a wedge.
+  * **At-most-once adds**: every logical ``add`` carries one req_id for
+    its whole retry span; the shard remembers each client's last applied
+    add and answers a retried duplicate from cache WITHOUT re-applying
+    (the lost-reply shape — chaos ``rpc_drop_rate`` — cannot double-count
+    experience on a shard).  Re-routing an add to a DIFFERENT shard after
+    a deadline is at-least-once across the fleet by design: a duplicated
+    experience chunk is harmless to replay, a lost one is the loss
+    Ape-X already tolerates.
+  * **Supervision + recovery** (:class:`ReplayServiceFleet`): shard
+    processes respawn under the supervisor's RespawnPolicy arithmetic
+    (exponential backoff + jitter + crash-loop quarantine), each
+    incarnation recovers from the shard's own incremental checkpoint
+    chain (``utils/checkpoint_inc`` — corruption walks back through the
+    existing fallback rungs), announces itself with a fresh incarnation
+    number, and the fleet rewrites the endpoints file atomically so
+    clients re-resolve moved shards.  A mid-run SIGKILL therefore yields
+    bit-exact-or-typed recovery: the respawned shard's ``state_digest``
+    equals the committed chain's content crc, or the restore is a typed
+    ``degraded_restore`` — never silently wrong samples.
+
+Hello handshake (one struct each way, before any framing state):
+
+    client → shard:  4s "APXR" | u32 version | i64 client_id | i64
+                     shard_id | i64 incarnation | i64 token | u8 codec
+    shard  → client: 4s "APXA" | u32 version | i64 shard_id | i64
+                     incarnation | i64 capacity | i64 count
+
+A hello with the wrong magic/version/shard_id/token — or a STALE
+incarnation (the client pinning an incarnation that has since respawned)
+— is rejected by closing before the ack, counted on ``stale_rejects`` /
+``bad_hellos``; the client re-resolves the endpoint and reconnects.
+``incarnation = -1`` in the hello means "current" (the normal client
+mode; the ack tells the client what it connected to).
+
+Import-light by design (stdlib + numpy + the shm_ring/net codecs): a
+shard process never needs jax, so a fleet spawns in well under a second
+per shard.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import secrets
+import select
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ape_x_dqn_tpu.runtime.net import (
+    CODEC_OFF,
+    CODEC_ZLIB,
+    F_RERR,
+    F_RREP,
+    F_RREQ,
+    Backoff,
+    FrameParser,
+    decode_xpb_payload,
+    encode_xpb_payload,
+    frame_bytes,
+)
+from ape_x_dqn_tpu.runtime.shm_ring import XP, decode_chunk, encode_chunk_parts
+
+RSVC_MAGIC = b"APXR"
+RSVC_ACK_MAGIC = b"APXA"
+RSVC_VERSION = 1
+# magic, version, client_id, shard_id, incarnation, token, codec
+RSVC_HELLO = struct.Struct("<4sIqqqqB7x")
+# magic, version, shard_id, incarnation, capacity, count
+RSVC_ACK = struct.Struct("<4sIqqqq")
+
+# RPC ops.
+OP_SAMPLE = 1
+OP_ADD = 2
+OP_UPDATE = 3
+OP_DIGEST = 4
+OP_STATS = 5
+_OP_NAMES = {OP_SAMPLE: "sample", OP_ADD: "add", OP_UPDATE: "update",
+             OP_DIGEST: "digest", OP_STATS: "stats"}
+
+# Typed refusal codes (F_RERR payloads).
+RE_BAD_REQUEST = 1   # well-framed but undecodable/ill-shaped request
+RE_EMPTY = 2         # sample against an empty shard
+RE_CLOSED = 3        # shard shutting down
+RE_INTERNAL = 4      # op raised; the exception type rides the message
+
+_RPC = struct.Struct("<QB7x")        # request head: req_id, op
+_RREP = struct.Struct("<QBB6x")      # reply head: req_id, op, flags
+_RERR = struct.Struct("<QH6x")       # error head: req_id, code | message
+FLAG_DUP = 1                         # add reply served from the dedup cache
+_SAMPLE_REQ = struct.Struct("<I4xdQ")   # batch_size, beta, sample seed
+_SAMPLE_REP = struct.Struct("<dq")      # shard total p^α mass, shard size
+_DIGEST_REQ = struct.Struct("<B7x")     # with_crc flag
+# count, cursor, size, incarnation, capacity, total_mass, crc
+_DIGEST_REP = struct.Struct("<qqqqqdI4x")
+
+_CODEC_IDS = {"off": CODEC_OFF, "zlib": CODEC_ZLIB}
+_RECV_CHUNK = 1 << 16
+_DEFAULT_MAX_FRAME = 64 << 20
+
+
+class ReplayShardUnavailable(RuntimeError):
+    """A replay RPC could not be served within its deadline — the shard
+    (or, from :class:`ShardedReplayClient`, every shard) is down.  The
+    typed degradation signal: callers route around it, buffer against it,
+    or surface it; nothing ever silently samples wrong data."""
+
+    def __init__(self, message: str, shard_id: Optional[int] = None,
+                 op: Optional[str] = None):
+        super().__init__(message)
+        self.shard_id = shard_id
+        self.op = op
+
+
+class ReplayRpcError(RuntimeError):
+    """A typed F_RERR refusal from a shard (bad request / empty /
+    internal) — the request WAS answered; this is not unavailability."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"replay rpc error {code}: {message}")
+        self.code = code
+
+
+# ---------------------------------------------------------------------------
+# RPC body codec: numpy dicts ride the APXT record format wrapped in the
+# wire-efficiency container (F_XPB: in-window frame dedup + negotiated
+# zlib).  One record per body; ``obs``/``next_obs`` uint8 leaves are
+# exactly what the dedup encoder's span walk targets, so n-step overlap
+# inside an add chunk ships each frame once — the 0.63 KB/transition
+# economy, carried through to the replay plane.
+# ---------------------------------------------------------------------------
+
+
+def encode_body(arrays: Dict[str, np.ndarray], codec: int = CODEC_OFF,
+                dedup: bool = True) -> bytes:
+    rec = b"".join(
+        bytes(p) if isinstance(p, (bytes, bytearray)) else memoryview(p)
+        .cast("B").tobytes()
+        for p in encode_chunk_parts(XP, 0, 0, arrays)
+    )
+    payload, _st = encode_xpb_payload([rec], codec=codec, dedup=dedup)
+    return payload
+
+
+def decode_body(payload, allow_zlib: bool = True,
+                max_bytes: int = _DEFAULT_MAX_FRAME) -> Dict[str, np.ndarray]:
+    """Arrays from one verified RPC body.  Raises ValueError on ANY
+    malformation (bad codec, out-of-window dedup ref, truncated tables,
+    short APXT buffers) — the caller counts torn / replies typed."""
+    recs = decode_xpb_payload(payload, allow_zlib=allow_zlib,
+                              max_bytes=max_bytes)
+    if len(recs) != 1:
+        raise ValueError(f"rpc body: expected 1 record, got {len(recs)}")
+    return decode_chunk(recs[0], copy=True)[8]
+
+
+class _Transition:
+    """Attribute shim matching the replay's batch surface (obs/action/
+    reward/discount/next_obs) without importing the jax-typed
+    NStepTransition into shard processes."""
+
+    __slots__ = ("obs", "action", "reward", "discount", "next_obs")
+
+    def __init__(self, arrays: Dict[str, np.ndarray]):
+        for k in self.__slots__:
+            setattr(self, k, arrays[k])
+
+
+# ---------------------------------------------------------------------------
+# Shard server.
+# ---------------------------------------------------------------------------
+
+
+class _RConn:
+    __slots__ = ("sock", "parser", "hello", "client_id", "codec", "outbox",
+                 "out_off", "out_seq", "bytes_in", "bytes_out")
+
+    def __init__(self, sock: socket.socket, max_frame: int):
+        self.sock = sock
+        self.parser = FrameParser(max_frame=max_frame)
+        self.hello = bytearray()
+        self.client_id: Optional[int] = None   # None until the ack went out
+        self.codec = CODEC_OFF
+        self.outbox: collections.deque = collections.deque()
+        self.out_off = 0
+        self.out_seq = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+
+
+class ReplayShardServer:
+    """One replay shard: a PrioritizedReplay behind a framed-RPC socket
+    front, with its own incremental checkpoint chain.
+
+    A single pump thread runs accept + hello + parse + execute + reply in
+    a select loop (replay ops are host-memory array work — there is no
+    compute tier to batch behind, so inline execution IS the latency
+    floor; one slow op delays the loop exactly as long as the op takes).
+    The wall-cadence checkpoint save rides the same thread, so snapshots
+    and mutations are serialized by construction.
+    """
+
+    def __init__(self, replay, shard_id: int, *, incarnation: int = 0,
+                 token: int = 0, host: str = "127.0.0.1", port: int = 0,
+                 codec: str = "zlib",
+                 max_request_bytes: int = _DEFAULT_MAX_FRAME,
+                 ckpt_dir: Optional[str] = None, save_every_s: float = 0.0,
+                 base_every: int = 16, chaos=None, on_event=None):
+        if codec not in _CODEC_IDS:
+            raise ValueError(f"unknown replay service codec: {codec}")
+        self.replay = replay
+        self.shard_id = int(shard_id)
+        self.incarnation = int(incarnation)
+        self.token = int(token)
+        self._codec_policy = codec
+        self._accept_codecs = (
+            {CODEC_OFF} if codec == "off" else {CODEC_OFF, CODEC_ZLIB}
+        )
+        self._max_frame = int(max_request_bytes)
+        self._chaos = chaos
+        self._on_event = on_event
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, int(port)))
+        self._lsock.listen(128)
+        self._lsock.setblocking(False)
+        self.host = host
+        self.port = self._lsock.getsockname()[1]
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._lock = threading.Lock()
+        self._conns: Dict[int, _RConn] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"replay-shard{shard_id}", daemon=True
+        )
+        self._started = False
+        # At-most-once adds: client_id -> (last applied req_id, its reply
+        # payload).  A retried duplicate is answered from here WITHOUT
+        # re-applying; req_ids are monotone per client by contract.
+        self._last_add: Dict[int, Tuple[int, bytes]] = {}
+        # Counters (the shard half of the replay_svc schema).
+        self.accepted = 0
+        self.requests = 0
+        self.replies = 0
+        self.errors = 0
+        self.torn_frames = 0
+        self.bad_hellos = 0
+        self.stale_rejects = 0
+        self.add_dups = 0
+        self.ops = {name: 0 for name in _OP_NAMES.values()}
+        self.chaos_dropped = 0
+        self.chaos_delay_s = 0.0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.logical_bytes_in = 0   # decoded add/update record bytes
+        # Shard-owned persistence: the incremental chain under
+        # <ckpt_dir>; save() runs on the pump thread at the wall cadence
+        # (step = transitions ever added — the shard's own clock).
+        self._ckpt = None
+        self._save_every_s = float(save_every_s)
+        self._next_save = time.monotonic() + self._save_every_s
+        self.saves = 0
+        if ckpt_dir:
+            from ape_x_dqn_tpu.utils.checkpoint_inc import (
+                IncrementalCheckpointer,
+            )
+
+            self._ckpt = IncrementalCheckpointer(
+                ckpt_dir, replay, base_every=base_every
+            )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ReplayShardServer":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake()
+        if self._started:
+            self._thread.join(timeout=10.0)
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        self._wake_r.close()
+        self._wake_w.close()
+        if self._ckpt is not None:
+            # Final committed snapshot so a clean stop never loses the
+            # tail (a SIGKILL loses at most one save interval — the chain
+            # is the recovery contract either way).
+            try:
+                self._ckpt.save(int(self.replay.total_added))
+                self._ckpt.close(timeout=30.0)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    def __enter__(self) -> "ReplayShardServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\x00")
+        except OSError:
+            pass
+
+    def _event(self, kind: str, **fields) -> None:
+        if self._on_event is not None:
+            try:
+                self._on_event(kind, shard=self.shard_id, **fields)
+            except Exception:  # noqa: BLE001 — telemetry must not serve
+                pass
+
+    # -- pump thread -------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                socks = {c.sock: c for c in self._conns.values()}
+                wlist = [c.sock for c in self._conns.values() if c.outbox]
+            rlist = [self._lsock, self._wake_r, *socks]
+            try:
+                r, w, _ = select.select(rlist, wlist, [], 0.25)
+            except (OSError, ValueError):
+                time.sleep(0.005)
+                continue
+            if self._wake_r in r:
+                try:
+                    while self._wake_r.recv(4096):
+                        pass
+                except OSError:
+                    pass
+            if self._lsock in r:
+                self._accept_pending()
+            for sock in w:
+                conn = socks.get(sock)
+                if conn is not None:
+                    self._flush(conn)
+            for sock in r:
+                conn = socks.get(sock)
+                if conn is not None:
+                    self._on_readable(conn)
+            self._maybe_save()
+
+    def _maybe_save(self) -> None:
+        if self._ckpt is None or self._save_every_s <= 0:
+            return
+        now = time.monotonic()
+        if now < self._next_save:
+            return
+        self._next_save = now + self._save_every_s
+        try:
+            if self._ckpt.save(int(self.replay.total_added)):
+                self.saves += 1
+        except Exception as e:  # noqa: BLE001 — a dead writer is an event
+            self._event("shard_ckpt_error",
+                        error=f"{type(e).__name__}: {e}")
+
+    def _accept_pending(self) -> None:
+        while True:
+            try:
+                sock, _addr = self._lsock.accept()
+            except (BlockingIOError, InterruptedError, OSError):
+                return
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            self.accepted += 1
+            with self._lock:
+                self._conns[sock.fileno()] = _RConn(sock, self._max_frame)
+
+    def _retire(self, conn: _RConn, torn: bool = False) -> None:
+        if torn or conn.parser.pending() or conn.parser.error is not None:
+            self.torn_frames += 1
+        with self._lock:
+            self._conns.pop(conn.sock.fileno(), None)
+            self.bytes_in += conn.bytes_in
+            self.bytes_out += conn.bytes_out
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _on_readable(self, conn: _RConn) -> None:
+        while True:
+            try:
+                data = conn.sock.recv(_RECV_CHUNK)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._retire(conn)
+                return
+            if not data:
+                self._retire(conn)
+                return
+            conn.bytes_in += len(data)
+            if conn.client_id is None:
+                need = RSVC_HELLO.size - len(conn.hello)
+                conn.hello += data[:need]
+                data = data[need:]
+                if len(conn.hello) == RSVC_HELLO.size:
+                    if not self._admit(conn):
+                        return
+                if not data:
+                    continue
+            conn.parser.feed(data)
+        if conn.client_id is not None:
+            self._drain_frames(conn)
+
+    def _admit(self, conn: _RConn) -> bool:
+        """Verify the hello; ack or reject-by-close.  A stale incarnation
+        (the client pinning one this shard has outlived — or a client
+        from before a respawn pinning the OLD incarnation against the new
+        process) is rejected BEFORE any framing state exists."""
+        try:
+            magic, version, client_id, shard_id, incarnation, token, codec \
+                = RSVC_HELLO.unpack(bytes(conn.hello))
+        except struct.error:
+            magic = b""
+            version = client_id = shard_id = incarnation = token = -1
+            codec = 255
+        ok = (magic == RSVC_MAGIC and version == RSVC_VERSION
+              and shard_id == self.shard_id and token == self.token)
+        stale = ok and incarnation not in (-1, self.incarnation)
+        if stale:
+            self.stale_rejects += 1
+        elif not ok:
+            self.bad_hellos += 1
+        if ok and not stale and codec not in self._accept_codecs:
+            # Codec-mismatch hello: refused at the handshake, the
+            # experience plane's codec_rejects rung.
+            self.bad_hellos += 1
+            ok = False
+        if not ok or stale:
+            self._retire(conn)
+            return False
+        conn.client_id = int(client_id)
+        conn.codec = int(codec)
+        ack = RSVC_ACK.pack(
+            RSVC_ACK_MAGIC, RSVC_VERSION, self.shard_id, self.incarnation,
+            int(self.replay.capacity), int(self.replay.total_added),
+        )
+        conn.outbox.append(ack)   # raw bytes before the framed stream
+        self._flush(conn)
+        return True
+
+    def _drain_frames(self, conn: _RConn) -> None:
+        while True:
+            got = conn.parser.next()
+            if got is None:
+                if conn.parser.error is not None:
+                    self._retire(conn, torn=True)
+                return
+            kind, payload = got
+            if kind != F_RREQ:
+                # Reply kinds only flow shard → client: stream corruption,
+                # connection-level recovery.
+                self._retire(conn, torn=True)
+                return
+            self._handle(conn, payload)
+
+    # -- request execution -------------------------------------------------
+
+    def _handle(self, conn: _RConn, payload: bytes) -> None:
+        if len(payload) < _RPC.size:
+            self.errors += 1
+            self._reply_err(conn, 0, RE_BAD_REQUEST, "short rpc head")
+            return
+        req_id, op = _RPC.unpack_from(payload, 0)
+        body = memoryview(payload)[_RPC.size:]
+        self.requests += 1
+        if self._chaos is not None:
+            d = self._chaos.delay_s()
+            if d > 0:
+                # Injected service latency: sleeping the pump thread IS
+                # the fault (every queued request behind it waits too).
+                self.chaos_delay_s += d
+                time.sleep(d)
+            if self._chaos.drop():
+                # Silently dropped request: the lost-reply shape.  The
+                # client's deadline expires and it retries whole.
+                self.chaos_dropped += 1
+                return
+        try:
+            if op == OP_ADD:
+                self._op_add(conn, req_id, body)
+            elif op == OP_SAMPLE:
+                self._op_sample(conn, req_id, body)
+            elif op == OP_UPDATE:
+                self._op_update(conn, req_id, body)
+            elif op == OP_DIGEST:
+                self._op_digest(conn, req_id, body)
+            elif op == OP_STATS:
+                self.ops["stats"] += 1
+                self._reply(conn, req_id, op,
+                            json.dumps(self.stats()).encode())
+            else:
+                self.errors += 1
+                self._reply_err(conn, req_id, RE_BAD_REQUEST,
+                                f"unknown op {op}")
+        except ValueError as e:
+            # Well-framed but undecodable/ill-shaped body (the crc already
+            # verified these bytes arrived intact): typed, not torn.
+            self.errors += 1
+            self._reply_err(conn, req_id, RE_BAD_REQUEST, str(e))
+        except Exception as e:  # noqa: BLE001 — op raised: typed internal
+            self.errors += 1
+            self._reply_err(conn, req_id, RE_INTERNAL,
+                            f"{type(e).__name__}: {e}")
+
+    def _op_add(self, conn: _RConn, req_id: int, body) -> None:
+        self.ops["add"] += 1
+        last = self._last_add.get(conn.client_id)
+        if last is not None and req_id <= last[0]:
+            # Duplicate of an ALREADY-APPLIED add (the reply was lost):
+            # at-most-once per req_id — answer from cache, never re-apply.
+            self.add_dups += 1
+            if req_id == last[0]:
+                self._reply(conn, req_id, OP_ADD, last[1], flags=FLAG_DUP)
+            else:
+                self._reply_err(conn, req_id, RE_BAD_REQUEST,
+                                "stale add req_id")
+            return
+        arrays = decode_body(body, allow_zlib=conn.codec != CODEC_OFF,
+                             max_bytes=self._max_frame)
+        self.logical_bytes_in += sum(a.nbytes for a in arrays.values())
+        prio = np.asarray(arrays.pop("prio"), np.float64)
+        idx = self.replay.add(prio, _Transition(arrays))
+        rep = encode_body({"idx": np.asarray(idx, np.int64)},
+                          codec=CODEC_OFF, dedup=False)
+        self._last_add[conn.client_id] = (int(req_id), rep)
+        self._reply(conn, req_id, OP_ADD, rep)
+
+    def _op_sample(self, conn: _RConn, req_id: int, body) -> None:
+        self.ops["sample"] += 1
+        if len(body) < _SAMPLE_REQ.size:
+            raise ValueError("short sample request")
+        batch, _beta, seed = _SAMPLE_REQ.unpack_from(body, 0)
+        if not 0 < batch <= 1 << 16:
+            raise ValueError(f"absurd sample batch {batch}")
+        if self.replay.size() == 0:
+            self.errors += 1
+            self._reply_err(conn, req_id, RE_EMPTY, "empty shard")
+            return
+        rng = np.random.default_rng(int(seed))
+        transition, idx, mass, total, size = self.replay.sample_with_mass(
+            int(batch), rng
+        )
+        rep_body = encode_body(
+            {
+                "obs": np.asarray(transition.obs),
+                "action": np.asarray(transition.action),
+                "reward": np.asarray(transition.reward),
+                "discount": np.asarray(transition.discount),
+                "next_obs": np.asarray(transition.next_obs),
+                "idx": np.asarray(idx, np.int64),
+                "mass": np.asarray(mass, np.float64),
+            },
+            codec=_CODEC_IDS[self._codec_policy]
+            if conn.codec != CODEC_OFF else CODEC_OFF,
+            dedup=True,
+        )
+        self._reply(conn, req_id, OP_SAMPLE,
+                    _SAMPLE_REP.pack(float(total), int(size)) + rep_body)
+
+    def _op_update(self, conn: _RConn, req_id: int, body) -> None:
+        self.ops["update"] += 1
+        arrays = decode_body(body, allow_zlib=conn.codec != CODEC_OFF,
+                             max_bytes=self._max_frame)
+        self.logical_bytes_in += sum(a.nbytes for a in arrays.values())
+        idx = np.asarray(arrays["idx"], np.int64)
+        prio = np.asarray(arrays["prio"], np.float64)
+        if idx.shape != prio.shape:
+            raise ValueError("update idx/prio shape mismatch")
+        if idx.size and (idx.min() < 0 or idx.max() >= self.replay.capacity):
+            raise ValueError("update index outside the shard's slot range")
+        self.replay.update_priorities(idx, prio)
+        self._reply(conn, req_id, OP_UPDATE, b"")
+
+    def _op_digest(self, conn: _RConn, req_id: int, body) -> None:
+        self.ops["digest"] += 1
+        with_crc = bool(len(body) >= _DIGEST_REQ.size
+                        and _DIGEST_REQ.unpack_from(body, 0)[0])
+        d = self.replay.digest(with_crc=with_crc)
+        self._reply(conn, req_id, OP_DIGEST, _DIGEST_REP.pack(
+            d["count"], d["cursor"], d["size"], self.incarnation,
+            int(self.replay.capacity), d["total_mass"], d["crc"],
+        ))
+
+    # -- reply path --------------------------------------------------------
+
+    def _reply(self, conn: _RConn, req_id: int, op: int, body,
+               flags: int = 0) -> None:
+        self.replies += 1
+        self._enqueue(conn, F_RREP, _RREP.pack(int(req_id), int(op),
+                                               int(flags)) + bytes(body))
+
+    def _reply_err(self, conn: _RConn, req_id: int, code: int,
+                   message: str) -> None:
+        self._enqueue(conn, F_RERR,
+                      _RERR.pack(int(req_id), int(code))
+                      + message.encode()[:512])
+
+    def _enqueue(self, conn: _RConn, kind: int, body: bytes) -> None:
+        with self._lock:
+            if self._conns.get(conn.sock.fileno()) is not conn:
+                return
+            conn.out_seq += 1
+            conn.outbox.append(frame_bytes(kind, conn.out_seq, [body]))
+        self._flush(conn)
+
+    def _flush(self, conn: _RConn) -> None:
+        while True:
+            with self._lock:
+                if not conn.outbox:
+                    return
+                buf = conn.outbox[0]
+            try:
+                n = conn.sock.send(memoryview(buf)[conn.out_off:])
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._retire(conn)
+                return
+            conn.bytes_out += n
+            conn.out_off += n
+            if conn.out_off >= len(buf):
+                conn.out_off = 0
+                with self._lock:
+                    if conn.outbox:
+                        conn.outbox.popleft()
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            conns = [c for c in self._conns.values()
+                     if c.client_id is not None]
+            bytes_in = self.bytes_in + sum(
+                c.bytes_in for c in self._conns.values()
+            )
+            bytes_out = self.bytes_out + sum(
+                c.bytes_out for c in self._conns.values()
+            )
+        out = {
+            "shard": self.shard_id,
+            "incarnation": self.incarnation,
+            "port": self.port,
+            "connections": len(conns),
+            "accepted": self.accepted,
+            "requests": self.requests,
+            "replies": self.replies,
+            "errors": self.errors,
+            "torn_frames": self.torn_frames,
+            "bad_hellos": self.bad_hellos,
+            "stale_rejects": self.stale_rejects,
+            "add_dups": self.add_dups,
+            "ops": dict(self.ops),
+            "chaos_dropped": self.chaos_dropped,
+            "bytes_in": bytes_in,
+            "bytes_out": bytes_out,
+            "logical_bytes_in": self.logical_bytes_in,
+            "size": int(self.replay.size()),
+            "total_added": int(self.replay.total_added),
+            "saves": self.saves,
+        }
+        if self._ckpt is not None:
+            out["ckpt"] = self._ckpt.stats()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Client side: one retrying shard client + the fleet-wide facade.
+# ---------------------------------------------------------------------------
+
+
+class ShardClient:
+    """Blocking retrying RPC client against one shard — the ServingClient
+    discipline on the replay plane: per-request deadline, jittered
+    exponential reconnect backoff, WHOLE-request retry across reconnects
+    (same req_id for the request's whole retry span — the shard's
+    at-most-once add dedup keys on it), and a backoff that resets ONLY on
+    a verified reply, so a dead shard is probed at backoff pace, never
+    hammered.
+
+    The endpoint (host/port/incarnation) is a mutable registry view the
+    owner updates after a re-resolve; the hello pins the registry's
+    incarnation when known, so a stale view is rejected at the handshake
+    instead of talking to the wrong process generation.
+    """
+
+    def __init__(self, shard_id: int, host: str, port: int, *, token: int,
+                 client_id: int, incarnation: int = -1, codec: str = "zlib",
+                 connect_timeout_s: float = 1.0, io_timeout_s: float = 5.0,
+                 max_frame: int = _DEFAULT_MAX_FRAME, seed: int = 0,
+                 on_incarnation: Optional[Callable[[int, int], None]] = None):
+        if codec not in _CODEC_IDS:
+            raise ValueError(f"unknown replay service codec: {codec}")
+        self.shard_id = int(shard_id)
+        self.host = host
+        self.port = int(port)
+        self.token = int(token)
+        self.client_id = int(client_id)
+        self.incarnation = int(incarnation)   # registry view; -1 = unknown
+        self.codec = codec
+        self._codec_id = _CODEC_IDS[codec]
+        self._connect_timeout = float(connect_timeout_s)
+        self._io_timeout = float(io_timeout_s)
+        self._max_frame = int(max_frame)
+        self._on_incarnation = on_incarnation
+        self._sock: Optional[socket.socket] = None
+        self._parser = FrameParser(max_frame=max_frame)
+        self._backoff = Backoff(base_s=0.05, max_s=1.0,
+                                seed=seed ^ (shard_id << 4))
+        self._req_id = 0
+        self._out_seq = 0
+        self.capacity = 0             # learned from the ack
+        self.reconnects = 0
+        self.retries = 0
+        self.torn = 0                 # parser faults / protocol violations
+        self.hello_rejects = 0        # closed before the ack (stale/token)
+        self._ever_connected = False
+
+    # -- connection --------------------------------------------------------
+
+    def set_endpoint(self, host: str, port: int, incarnation: int) -> None:
+        """Adopt a re-resolved endpoint (the fleet moved the shard).  An
+        open connection to the OLD endpoint is dropped."""
+        if (host, int(port)) != (self.host, self.port) \
+                or int(incarnation) != self.incarnation:
+            self.host, self.port = host, int(port)
+            self.incarnation = int(incarnation)
+            self._drop()
+            self._backoff.reset()
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _ensure_connected(self, deadline: float) -> bool:
+        if self._sock is not None:
+            return True
+        if not self._backoff.ready():
+            return False
+        try:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=self._connect_timeout
+            )
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.sendall(RSVC_HELLO.pack(
+                RSVC_MAGIC, RSVC_VERSION, self.client_id, self.shard_id,
+                self.incarnation, self.token, self._codec_id,
+            ))
+            sock.settimeout(
+                max(0.05, min(self._io_timeout,
+                              deadline - time.monotonic()))
+            )
+            ack = b""
+            while len(ack) < RSVC_ACK.size:
+                got = sock.recv(RSVC_ACK.size - len(ack))
+                if not got:
+                    raise OSError("closed before ack (stale/rejected hello)")
+                ack += got
+            magic, version, shard_id, incarnation, capacity, _count = \
+                RSVC_ACK.unpack(ack)
+            if magic != RSVC_ACK_MAGIC or version != RSVC_VERSION \
+                    or shard_id != self.shard_id:
+                raise OSError("bad ack")
+        except (OSError, socket.timeout) as e:
+            if "rejected" in str(e):
+                self.hello_rejects += 1
+            self._backoff.fail()
+            return False
+        self._sock = sock
+        self._parser = FrameParser(max_frame=self._max_frame)
+        self._out_seq = 0
+        self.capacity = int(capacity)
+        if incarnation != self.incarnation:
+            self.incarnation = int(incarnation)
+            if self._on_incarnation is not None:
+                self._on_incarnation(self.shard_id, int(incarnation))
+        # NB: the backoff resets on a verified REPLY, not here — an
+        # accept-then-die shard must not turn the client into a tight
+        # connect loop (the ServingClient discipline, pinned by tests).
+        self.reconnects += int(self._ever_connected)
+        self._ever_connected = True
+        return True
+
+    # -- request path ------------------------------------------------------
+
+    def next_req_id(self) -> int:
+        self._req_id += 1
+        return self._req_id
+
+    def request(self, op: int, body: bytes = b"",
+                timeout: float = 10.0,
+                req_id: Optional[int] = None) -> Tuple[int, bytes]:
+        """(flags, reply payload past the head) for one RPC, across
+        reconnects and whole-request retries.  Raises
+        :class:`ReplayRpcError` on a typed refusal (the request WAS
+        answered) and :class:`ReplayShardUnavailable` when the deadline
+        expires unanswered."""
+        deadline = time.monotonic() + timeout
+        rid = self.next_req_id() if req_id is None else int(req_id)
+        payload = _RPC.pack(rid, int(op)) + body
+        first = True
+        while time.monotonic() < deadline:
+            if not self._ensure_connected(deadline):
+                time.sleep(0.005)
+                continue
+            if not first:
+                self.retries += 1
+            first = False
+            try:
+                self._out_seq += 1
+                self._sock.sendall(
+                    frame_bytes(F_RREQ, self._out_seq, [payload])
+                )
+                got = self._await(rid, deadline)
+            except (OSError, socket.timeout):
+                self._drop()
+                self._backoff.fail()
+                continue
+            if got is None:          # torn stream / stale reply: retry
+                continue
+            kind, reply = got
+            if kind == F_RREP:
+                self._backoff.reset()
+                _rid, _rop, flags = _RREP.unpack_from(reply, 0)
+                return int(flags), bytes(reply[_RREP.size:])
+            _rid, code = _RERR.unpack_from(reply, 0)
+            msg = bytes(reply[_RERR.size:]).decode(errors="replace")
+            if code == RE_CLOSED:
+                # Shard draining: reconnect (the respawn will re-admit).
+                self._drop()
+                self._backoff.fail()
+                continue
+            self._backoff.reset()    # transport verified; typed refusal
+            raise ReplayRpcError(int(code), msg)
+        raise ReplayShardUnavailable(
+            f"shard {self.shard_id} ({self.host}:{self.port}) gave no "
+            f"reply within {timeout:.1f}s (retries={self.retries}, "
+            f"reconnects={self.reconnects})",
+            shard_id=self.shard_id, op=_OP_NAMES.get(op, str(op)),
+        )
+
+    def _await(self, rid: int, deadline: float):
+        while True:
+            got = self._parser.next()
+            if got is not None:
+                kind, payload = got
+                if kind == F_RREP:
+                    if len(payload) >= _RREP.size \
+                            and _RREP.unpack_from(payload, 0)[0] == rid:
+                        return kind, payload
+                    continue          # stale reply from a retried request
+                if kind == F_RERR:
+                    if len(payload) >= _RERR.size \
+                            and _RERR.unpack_from(payload, 0)[0] in (rid, 0):
+                        return kind, payload
+                    continue
+                # Unknown kind: protocol violation — torn.
+                self.torn += 1
+                self._drop()
+                self._backoff.fail()
+                return None
+            if self._parser.error is not None:
+                self.torn += 1
+                self._drop()
+                self._backoff.fail()
+                return None
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("deadline")
+            self._sock.settimeout(min(self._io_timeout, remaining))
+            data = self._sock.recv(_RECV_CHUNK)
+            if not data:
+                raise OSError("connection closed by peer")
+            self._parser.feed(data)
+
+    # -- typed ops ---------------------------------------------------------
+
+    def digest(self, with_crc: bool = False, timeout: float = 2.0) -> dict:
+        _flags, body = self.request(
+            OP_DIGEST, _DIGEST_REQ.pack(int(with_crc)), timeout=timeout
+        )
+        count, cursor, size, incarnation, capacity, total_mass, crc = \
+            _DIGEST_REP.unpack_from(body, 0)
+        return {"count": count, "cursor": cursor, "size": size,
+                "incarnation": incarnation, "capacity": capacity,
+                "total_mass": total_mass, "crc": crc}
+
+    def shard_stats(self, timeout: float = 2.0) -> dict:
+        _flags, body = self.request(OP_STATS, timeout=timeout)
+        return json.loads(body.decode())
+
+    def close(self) -> None:
+        self._drop()
+
+
+class ShardedReplayClient:
+    """The learner-facing replay: a PrioritizedReplay-shaped facade
+    (``add`` / ``sample`` / ``update_priorities`` / ``size``) over the
+    shard fleet, fault-tolerant by construction.
+
+    Degradation contract — a shard dying costs the learner THROUGHPUT,
+    never correctness and never a wedge:
+
+      * ``sample`` draws the whole batch from one shard chosen by p^α
+        mass among the HEALTHY shards (mass-weighted shard choice ×
+        in-shard proportional sampling = the global sampling law, modulo
+        the staleness of cached shard totals — the same order the async
+        Ape-X loop already tolerates); IS weights are normalized against
+        the GLOBAL (all-shard) total and size.
+      * ``add`` routes round-robin over healthy shards; a shard going
+        down mid-add re-routes to a survivor (at-least-once across the
+        fleet; at-most-once per shard via the req_id dedup).
+      * ``update_priorities`` routes by slot range; write-backs to a
+        down shard buffer LAST-WRITE-WINS client-side and flush as one
+        batched update when the background probe sees the shard return.
+      * Only when EVERY shard is unreachable does an op raise the typed
+        :class:`ReplayShardUnavailable`; ``age_s`` (the ``replay_svc``
+        health component) reports how long the fleet has been degraded.
+    """
+
+    remote = True
+
+    def __init__(self, shards: Sequence[dict], *, token: int,
+                 codec: str = "zlib", dedup: bool = True,
+                 request_timeout_s: float = 10.0,
+                 probe_interval_s: float = 0.5,
+                 client_id: Optional[int] = None,
+                 endpoints_path: Optional[str] = None,
+                 seed: int = 0, on_event=None):
+        shards = sorted(shards, key=lambda s: int(s["id"]))
+        if not shards:
+            raise ValueError("replay service needs >= 1 shard")
+        caps = {int(s["capacity"]) for s in shards}
+        if len(caps) != 1:
+            raise ValueError("shards must have uniform capacity "
+                             f"(got {sorted(caps)})")
+        self.shard_capacity = caps.pop()
+        self.num_shards = len(shards)
+        self.capacity = self.shard_capacity * self.num_shards
+        for k, s in enumerate(shards):
+            if int(s["id"]) != k or int(s["base"]) != k * self.shard_capacity:
+                raise ValueError("shard ids/bases must tile [0, capacity)")
+        self._dedup = bool(dedup)
+        self._codec_id = _CODEC_IDS[codec]
+        self._timeout = float(request_timeout_s)
+        self._probe_interval = float(probe_interval_s)
+        self._endpoints_path = endpoints_path
+        self._endpoints_mtime = 0.0
+        self._on_event = on_event
+        if client_id is None:
+            client_id = (os.getpid() << 16) ^ secrets.randbits(16)
+        self.client_id = int(client_id)
+        self._clients: List[ShardClient] = []
+        self._locks: List[threading.Lock] = []
+        for s in shards:
+            self._clients.append(ShardClient(
+                int(s["id"]), s["host"], int(s["port"]), token=int(token),
+                client_id=self.client_id,
+                incarnation=int(s.get("incarnation", -1)), codec=codec,
+                io_timeout_s=min(5.0, request_timeout_s),
+                seed=seed ^ self.client_id,
+            ))
+            self._locks.append(threading.Lock())
+        self._state = threading.Lock()
+        self._down: Dict[int, float] = {}        # sid -> down_since
+        self._pending: Dict[int, Dict[int, float]] = {}  # sid -> idx->prio
+        self._totals = [0.0] * self.num_shards   # cached p^α mass per shard
+        self._sizes = [0] * self.num_shards
+        self._size_t = 0.0
+        self._add_rr = 0
+        self._degraded_since: Optional[float] = None
+        # Counters (the client half of docs/METRICS.md "Replay service
+        # schema" — key set pinned by tests/test_replay_svc.py).
+        self.samples = 0
+        self.adds = 0
+        self.updates = 0
+        self.add_rerouted = 0
+        self.sample_rerouted = 0
+        self.shard_unavailable = 0     # per-shard deadline expiries seen
+        self.writeback_buffered = 0    # slots ever parked for a down shard
+        self.writeback_flushed = 0     # slots flushed on recovery
+        self.probes = 0
+        self.recoveries = 0
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_endpoints_file(cls, path: str, **kwargs) -> "ShardedReplayClient":
+        with open(path) as f:
+            doc = json.load(f)
+        kwargs.setdefault("codec", doc.get("codec", "zlib"))
+        return cls(doc["shards"], token=int(doc["token"]),
+                   endpoints_path=path, **kwargs)
+
+    # -- health ------------------------------------------------------------
+
+    def _healthy(self) -> List[int]:
+        with self._state:
+            return [k for k in range(self.num_shards) if k not in self._down]
+
+    @property
+    def degraded(self) -> bool:
+        with self._state:
+            return bool(self._down)
+
+    def age_s(self) -> float:
+        """The ``replay_svc`` /healthz component: 0 while every shard
+        answers; otherwise seconds since the fleet degraded."""
+        with self._state:
+            if not self._down:
+                return 0.0
+            return time.monotonic() - min(self._down.values())
+
+    def _mark_down(self, sid: int, reason: str) -> None:
+        start_probe = False
+        with self._state:
+            if sid not in self._down:
+                self._down[sid] = time.monotonic()
+                if self._degraded_since is None:
+                    self._degraded_since = self._down[sid]
+                start_probe = True
+        self.shard_unavailable += 1
+        if start_probe:
+            self._event("replay_shard_down", shard=sid, reason=reason)
+            self._ensure_probe_thread()
+
+    def _mark_up(self, sid: int) -> None:
+        with self._state:
+            self._down.pop(sid, None)
+            if not self._down:
+                self._degraded_since = None
+        self.recoveries += 1
+        self._event("replay_shard_recovered_client", shard=sid)
+
+    def _event(self, kind: str, **fields) -> None:
+        if self._on_event is not None:
+            try:
+                self._on_event(kind, **fields)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- the probe/recovery loop -------------------------------------------
+
+    def _ensure_probe_thread(self) -> None:
+        if self._probe_thread is None or not self._probe_thread.is_alive():
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="replay-svc-probe", daemon=True
+            )
+            self._probe_thread.start()
+
+    def _refresh_endpoints(self) -> None:
+        path = self._endpoints_path
+        if not path:
+            return
+        try:
+            mtime = os.path.getmtime(path)
+            if mtime == self._endpoints_mtime:
+                return
+            with open(path) as f:
+                doc = json.load(f)
+            self._endpoints_mtime = mtime
+        except (OSError, ValueError):
+            return
+        for s in doc.get("shards", []):
+            sid = int(s["id"])
+            if 0 <= sid < self.num_shards:
+                self._clients[sid].set_endpoint(
+                    s["host"], int(s["port"]), int(s.get("incarnation", -1))
+                )
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self._probe_interval):
+            with self._state:
+                down = list(self._down)
+            if not down:
+                continue
+            self._refresh_endpoints()
+            for sid in down:
+                self.probes += 1
+                try:
+                    with self._locks[sid]:
+                        self._clients[sid].digest(
+                            with_crc=False,
+                            timeout=max(0.25, self._probe_interval),
+                        )
+                        # Reachable again: flush the parked write-backs
+                        # BEFORE re-admitting it to the routing set, so a
+                        # sampler never races ahead of its own priorities.
+                        self._flush_pending_locked(sid)
+                except (ReplayShardUnavailable, ReplayRpcError):
+                    continue
+                self._mark_up(sid)
+
+    def _flush_pending_locked(self, sid: int) -> None:
+        """One batched last-write-wins update of everything parked for
+        ``sid`` (caller holds the shard lock)."""
+        with self._state:
+            pending = self._pending.pop(sid, None)
+        if not pending:
+            return
+        idx = np.fromiter(pending.keys(), np.int64, len(pending))
+        prio = np.fromiter(pending.values(), np.float64, len(pending))
+        try:
+            self._clients[sid].request(
+                OP_UPDATE,
+                encode_body({"idx": idx, "prio": prio},
+                            codec=self._codec_id, dedup=False),
+                timeout=self._timeout,
+            )
+            self.writeback_flushed += len(pending)
+            self._event("replay_writeback_flushed", shard=sid,
+                        slots=len(pending))
+        except (ReplayShardUnavailable, ReplayRpcError):
+            # Still (or newly) unreachable: park them again — later
+            # updates still win (dict.update order).
+            with self._state:
+                merged = self._pending.setdefault(sid, {})
+                for k, v in pending.items():
+                    merged.setdefault(k, v)
+            raise ReplayShardUnavailable(
+                f"shard {sid} reappeared but the write-back flush failed",
+                shard_id=sid, op="update",
+            )
+
+    # -- replay surface ----------------------------------------------------
+
+    def add(self, priorities: np.ndarray, batch) -> np.ndarray:
+        """Route one chunk to a healthy shard; returns GLOBAL slot
+        indices.  Re-routes to a survivor when the chosen shard dies
+        mid-request."""
+        arrays = {
+            "prio": np.asarray(priorities, np.float64),
+            "obs": np.asarray(batch.obs),
+            "action": np.asarray(batch.action),
+            "reward": np.asarray(batch.reward),
+            "discount": np.asarray(batch.discount),
+            "next_obs": np.asarray(batch.next_obs),
+        }
+        body = encode_body(arrays, codec=self._codec_id, dedup=self._dedup)
+        candidates = self._healthy() or list(range(self.num_shards))
+        self._add_rr += 1
+        order = candidates[self._add_rr % len(candidates):] \
+            + candidates[:self._add_rr % len(candidates)]
+        last_err: Optional[ReplayShardUnavailable] = None
+        for pos, sid in enumerate(order):
+            try:
+                with self._locks[sid]:
+                    _flags, rep = self._clients[sid].request(
+                        OP_ADD, body, timeout=self._timeout
+                    )
+                idx = decode_body(rep)["idx"]
+                self.adds += 1
+                if pos:
+                    self.add_rerouted += 1
+                with self._state:
+                    self._sizes[sid] = min(
+                        self._sizes[sid] + len(idx), self.shard_capacity
+                    )
+                return np.asarray(idx, np.int64) \
+                    + sid * self.shard_capacity
+            except ReplayShardUnavailable as e:
+                last_err = e
+                self._mark_down(sid, f"add: {e}")
+        raise last_err if last_err is not None else ReplayShardUnavailable(
+            "no healthy replay shard", op="add"
+        )
+
+    def sample(self, batch_size: int, beta: float = 0.4,
+               rng: Optional[np.random.Generator] = None):
+        """PrioritizedBatch with GLOBAL indices and globally-normalized
+        IS weights — the drop-in for PrioritizedReplay.sample."""
+        from ape_x_dqn_tpu.types import NStepTransition, PrioritizedBatch
+
+        rng = rng or np.random.default_rng()
+        candidates = self._healthy()
+        if not candidates:
+            candidates = list(range(self.num_shards))
+        with self._state:
+            totals = {k: max(0.0, self._totals[k]) for k in candidates}
+        # Mass-weighted shard order: positive-mass shards first (drawn
+        # without replacement ∝ their cached p^α totals — shard choice ×
+        # in-shard proportional = the global law), zero/unknown-mass
+        # shards shuffled behind them as fallbacks.
+        pos = [k for k in candidates if totals[k] > 0]
+        zero = [k for k in candidates if totals[k] <= 0]
+        order: List[int] = []
+        if pos:
+            p = np.asarray([totals[k] for k in pos])
+            order += list(rng.choice(pos, size=len(pos), replace=False,
+                                     p=p / p.sum()))
+        rng.shuffle(zero)
+        order += zero
+        last_err: Optional[BaseException] = None
+        for pos, sid in enumerate(map(int, order)):
+            seed = int(rng.integers(0, 2 ** 63 - 1))
+            try:
+                with self._locks[sid]:
+                    _flags, rep = self._clients[sid].request(
+                        OP_SAMPLE,
+                        _SAMPLE_REQ.pack(int(batch_size), float(beta), seed),
+                        timeout=self._timeout,
+                    )
+            except ReplayShardUnavailable as e:
+                last_err = e
+                self._mark_down(sid, f"sample: {e}")
+                continue
+            except ReplayRpcError as e:
+                if e.code == RE_EMPTY:       # fresh shard: try another
+                    last_err = e
+                    continue
+                raise
+            if pos:
+                self.sample_rerouted += 1
+            total, size = _SAMPLE_REP.unpack_from(rep, 0)
+            arrays = decode_body(rep[_SAMPLE_REP.size:])
+            with self._state:
+                self._totals[sid] = float(total)
+                self._sizes[sid] = int(size)
+                g_total = sum(self._totals)
+                g_size = sum(self._sizes)
+            self.samples += 1
+            mass = np.asarray(arrays["mass"], np.float64)
+            probs = mass / max(g_total, 1e-12)
+            w = np.power(
+                max(g_size, 1) * np.maximum(probs, 1e-12), -float(beta)
+            )
+            return PrioritizedBatch(
+                transition=NStepTransition(
+                    obs=arrays["obs"], action=arrays["action"],
+                    reward=arrays["reward"], discount=arrays["discount"],
+                    next_obs=arrays["next_obs"],
+                ),
+                indices=(np.asarray(arrays["idx"], np.int64)
+                         + sid * self.shard_capacity).astype(np.int32),
+                is_weights=(w / w.max()).astype(np.float32),
+            )
+        if isinstance(last_err, ReplayRpcError):
+            raise ValueError("cannot sample from an empty replay service")
+        raise last_err if last_err is not None else ReplayShardUnavailable(
+            "no healthy replay shard", op="sample"
+        )
+
+    def update_priorities(self, indices: np.ndarray,
+                          priorities: np.ndarray) -> None:
+        """Split by slot range; a down shard's slice buffers
+        last-write-wins and flushes on recovery — the learner never
+        blocks on a dead shard's priorities."""
+        indices = np.asarray(indices, np.int64)
+        priorities = np.asarray(priorities, np.float64)
+        if indices.size == 0:
+            return
+        sids = indices // self.shard_capacity
+        for sid in map(int, np.unique(sids)):
+            m = sids == sid
+            idx = indices[m] - sid * self.shard_capacity
+            prio = priorities[m]
+            with self._state:
+                down = sid in self._down
+            if down:
+                self._buffer_writeback(sid, idx, prio)
+                continue
+            try:
+                with self._locks[sid]:
+                    self._clients[sid].request(
+                        OP_UPDATE,
+                        encode_body({"idx": idx, "prio": prio},
+                                    codec=self._codec_id, dedup=False),
+                        timeout=self._timeout,
+                    )
+                self.updates += 1
+            except ReplayShardUnavailable as e:
+                self._buffer_writeback(sid, idx, prio)
+                self._mark_down(sid, f"update: {e}")
+
+    def _buffer_writeback(self, sid: int, idx: np.ndarray,
+                          prio: np.ndarray) -> None:
+        with self._state:
+            d = self._pending.setdefault(sid, {})
+            before = len(d)
+            d.update(zip(idx.tolist(), prio.tolist()))
+            self.writeback_buffered += len(idx)
+            # Bound the parked set: it can never exceed the shard's slot
+            # count (last-write-wins keys on the slot), so no cap needed —
+            # but account growth for the stats surface.
+            del before
+
+    # -- size/meta ---------------------------------------------------------
+
+    def size(self) -> int:
+        now = time.monotonic()
+        with self._state:
+            stale = now - self._size_t > 0.25
+            if stale:
+                self._size_t = now
+        if stale:
+            for sid in self._healthy():
+                try:
+                    with self._locks[sid]:
+                        d = self._clients[sid].digest(
+                            with_crc=False, timeout=min(2.0, self._timeout)
+                        )
+                    with self._state:
+                        self._sizes[sid] = int(d["size"])
+                        self._totals[sid] = float(d["total_mass"])
+                except (ReplayShardUnavailable, ReplayRpcError) as e:
+                    self._mark_down(sid, f"digest: {e}")
+        with self._state:
+            return int(sum(self._sizes))
+
+    @property
+    def total_added(self) -> int:
+        return self.adds
+
+    def frames_nbytes(self) -> int:
+        return 0   # remote: the shards own the bytes
+
+    def max_priority(self) -> float:
+        return 1.0
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``replay_svc`` JSONL / /varz section (docs/METRICS.md
+        "Replay service schema" — key set pinned by
+        tests/test_replay_svc.py)."""
+        with self._state:
+            down = sorted(self._down)
+            pending = sum(len(d) for d in self._pending.values())
+            sizes = list(self._sizes)
+            totals = list(self._totals)
+        return {
+            "shards": self.num_shards,
+            "shards_down": len(down),
+            "down": down,
+            "degraded": bool(down),
+            "degraded_age_s": round(self.age_s(), 3),
+            "size": int(sum(sizes)),
+            "total_mass": round(float(sum(totals)), 3),
+            "samples": self.samples,
+            "adds": self.adds,
+            "updates": self.updates,
+            "add_rerouted": self.add_rerouted,
+            "sample_rerouted": self.sample_rerouted,
+            "shard_unavailable": self.shard_unavailable,
+            "writeback_buffered": self.writeback_buffered,
+            "writeback_flushed": self.writeback_flushed,
+            "writeback_pending": pending,
+            "probes": self.probes,
+            "recoveries": self.recoveries,
+            "rpc_retries": sum(c.retries for c in self._clients),
+            "rpc_reconnects": sum(c.reconnects for c in self._clients),
+            "rpc_torn": sum(c.torn for c in self._clients),
+            "hello_rejects": sum(c.hello_rejects for c in self._clients),
+        }
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5.0)
+        for lock, c in zip(self._locks, self._clients):
+            with lock:
+                c.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet: shard subprocesses + supervision + the endpoints file.
+# ---------------------------------------------------------------------------
+
+
+class ReplayShardProcess:
+    """One shard subprocess: ``python -m ape_x_dqn_tpu.replay.service``
+    with its announce line parsed off stdout (the ReplicaProcess
+    discipline — ephemeral ports are fine because the fleet republishes
+    the endpoints file on every spawn)."""
+
+    def __init__(self, shard_id: int, capacity: int, obs_shape, *,
+                 token: int, root_dir: str, priority_exponent: float = 0.6,
+                 codec: str = "zlib", save_every_s: float = 2.0,
+                 base_every: int = 16, host: str = "127.0.0.1",
+                 rpc_delay_ms: float = 0.0, rpc_drop_rate: float = 0.0,
+                 chaos_seed: int = 0):
+        self.shard_id = int(shard_id)
+        self.capacity = int(capacity)
+        self.obs_shape = tuple(int(d) for d in obs_shape)
+        self.token = int(token)
+        # Absolute by contract: the shard subprocess runs with the REPO
+        # as its cwd (for the -m import), so a relative dir would land
+        # its chain inside the source tree.
+        self.root_dir = os.path.abspath(root_dir)
+        self.alpha = float(priority_exponent)
+        self.codec = codec
+        self.save_every_s = float(save_every_s)
+        self.base_every = int(base_every)
+        self.host = host
+        self.rpc_delay_ms = float(rpc_delay_ms)
+        self.rpc_drop_rate = float(rpc_drop_rate)
+        self.chaos_seed = int(chaos_seed)
+        self.incarnation = -1
+        self.port: Optional[int] = None
+        self.pid: Optional[int] = None
+        self.proc: Optional[subprocess.Popen] = None
+        self.events: List[dict] = []
+        self._announce = threading.Event()
+        self._reader: Optional[threading.Thread] = None
+
+    @property
+    def ckpt_dir(self) -> str:
+        return os.path.join(self.root_dir, f"shard{self.shard_id}")
+
+    def spawn(self) -> "ReplayShardProcess":
+        self.incarnation += 1
+        self.port = None
+        self._announce.clear()
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        args = [
+            sys.executable, "-m", "ape_x_dqn_tpu.replay.service",
+            "--shard-id", str(self.shard_id),
+            "--capacity", str(self.capacity),
+            "--obs-shape", ",".join(map(str, self.obs_shape)),
+            "--alpha", str(self.alpha),
+            "--token", str(self.token),
+            "--incarnation", str(self.incarnation),
+            "--host", self.host, "--port", "0",
+            "--codec", self.codec,
+            "--ckpt-dir", self.ckpt_dir,
+            "--save-every-s", str(self.save_every_s),
+            "--base-every", str(self.base_every),
+        ]
+        if self.rpc_delay_ms or self.rpc_drop_rate:
+            args += ["--rpc-delay-ms", str(self.rpc_delay_ms),
+                     "--rpc-drop-rate", str(self.rpc_drop_rate),
+                     "--chaos-seed", str(self.chaos_seed)]
+        stderr_log = open(   # noqa: SIM115 — lives as long as the child
+            os.path.join(self.ckpt_dir,
+                         f"shard{self.shard_id}.{self.incarnation}.log"),
+            "ab",
+        )
+        self.proc = subprocess.Popen(
+            args, stdout=subprocess.PIPE, stderr=stderr_log,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+        )
+        stderr_log.close()
+        self.pid = self.proc.pid
+        self._reader = threading.Thread(
+            target=self._read_stdout, args=(self.proc,),
+            name=f"shard{self.shard_id}-stdout", daemon=True,
+        )
+        self._reader.start()
+        return self
+
+    def _read_stdout(self, proc: subprocess.Popen) -> None:
+        for raw in iter(proc.stdout.readline, b""):
+            try:
+                ev = json.loads(raw.decode(errors="replace"))
+            except ValueError:
+                continue
+            self.events.append(ev)
+            if len(self.events) > 512:
+                del self.events[:128]
+            if ev.get("event") == "replay_shard_listen" \
+                    and ev.get("incarnation") == self.incarnation:
+                self.port = int(ev["port"])
+                self._announce.set()
+
+    def wait_announce(self, timeout: float = 30.0) -> bool:
+        return self._announce.wait(timeout)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def _reap_pipe(self) -> None:
+        # The stdout reader thread exits at EOF once the child is dead;
+        # close the pipe fd explicitly (the conftest fd-leak guard's
+        # discipline — teardown must not lean on GC).
+        if self._reader is not None:
+            self._reader.join(timeout=5.0)
+        if self.proc is not None and self.proc.stdout is not None:
+            try:
+                self.proc.stdout.close()
+            except OSError:
+                pass
+
+    def kill(self) -> None:
+        if self.alive():
+            os.kill(self.proc.pid, signal.SIGKILL)
+            self.proc.wait(timeout=10.0)
+        self._reap_pipe()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=5.0)
+        self._reap_pipe()
+
+
+class ReplayServiceFleet:
+    """Owner of the shard fleet: spawn, supervise (RespawnPolicy backoff
+    + crash-loop quarantine), endpoints publication, and the chaos
+    kill-shard hooks.  ``auto_respawn=False`` hands respawn timing to the
+    caller (the smoke's deterministic mid-kill chain inspection)."""
+
+    def __init__(self, num_shards: int, capacity: int, obs_shape, *,
+                 root_dir: str, priority_exponent: float = 0.6,
+                 codec: str = "zlib", save_every_s: float = 2.0,
+                 base_every: int = 16, endpoints_path: Optional[str] = None,
+                 auto_respawn: bool = True, respawn_base_s: float = 0.25,
+                 respawn_max_s: float = 5.0, crash_loop_budget: int = 6,
+                 rpc_delay_ms: float = 0.0, rpc_drop_rate: float = 0.0,
+                 kill_shard_at_step: int = 0, chaos_seed: int = 0,
+                 seed: int = 0, on_event=None):
+        if num_shards < 1:
+            raise ValueError("replay fleet needs >= 1 shard")
+        if capacity % num_shards:
+            raise ValueError(
+                f"capacity {capacity} must divide evenly into "
+                f"{num_shards} shards"
+            )
+        from ape_x_dqn_tpu.runtime.supervisor import RespawnPolicy
+
+        self.token = secrets.randbits(63) or 1
+        self.num_shards = int(num_shards)
+        self.capacity = int(capacity)
+        self.shard_capacity = self.capacity // self.num_shards
+        self.root_dir = os.path.abspath(root_dir)
+        root_dir = self.root_dir
+        os.makedirs(root_dir, exist_ok=True)
+        self.endpoints_path = endpoints_path or os.path.join(
+            root_dir, "endpoints.json"
+        )
+        self.codec = codec
+        self._on_event = on_event
+        self._auto_respawn = bool(auto_respawn)
+        self._respawn_policy = RespawnPolicy(
+            base_s=respawn_base_s, max_s=respawn_max_s,
+            budget=crash_loop_budget, seed=seed,
+        )
+        self._kill_at_step = int(kill_shard_at_step)
+        self._kill_fired = False
+        import random as _random
+
+        self._chaos_rng = _random.Random(chaos_seed ^ 0x5A4D)
+        self.shards = [
+            ReplayShardProcess(
+                k, self.shard_capacity, obs_shape, token=self.token,
+                root_dir=root_dir, priority_exponent=priority_exponent,
+                codec=codec, save_every_s=save_every_s,
+                base_every=base_every, rpc_delay_ms=rpc_delay_ms,
+                rpc_drop_rate=rpc_drop_rate, chaos_seed=chaos_seed + k,
+            )
+            for k in range(self.num_shards)
+        ]
+        self.respawns = 0
+        self.kills = 0
+        self.quarantined: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _event(self, kind: str, **fields) -> None:
+        if self._on_event is not None:
+            try:
+                self._on_event(kind, **fields)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- endpoints ---------------------------------------------------------
+
+    def write_endpoints(self) -> None:
+        """Atomic publish (tmp + rename — the manifest discipline): the
+        client's probe loop re-reads on mtime change."""
+        doc = {
+            "token": self.token,
+            "codec": self.codec,
+            "total_capacity": self.capacity,
+            "shards": [
+                {
+                    "id": s.shard_id, "host": s.host,
+                    "port": s.port if s.port is not None else -1,
+                    "base": s.shard_id * self.shard_capacity,
+                    "capacity": s.capacity,
+                    "incarnation": s.incarnation,
+                }
+                for s in self.shards
+            ],
+        }
+        tmp = self.endpoints_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.endpoints_path)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, timeout: float = 60.0) -> "ReplayServiceFleet":
+        deadline = time.monotonic() + timeout
+        for s in self.shards:
+            s.spawn()
+        for s in self.shards:
+            if not s.wait_announce(max(1.0, deadline - time.monotonic())):
+                raise TimeoutError(
+                    f"replay shard {s.shard_id} never announced its port "
+                    f"(see {s.ckpt_dir}/shard{s.shard_id}."
+                    f"{s.incarnation}.log)"
+                )
+        self.write_endpoints()
+        if self._auto_respawn:
+            self._thread = threading.Thread(
+                target=self._supervise_loop, name="replay-fleet", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def respawn(self, shard_id: int, timeout: float = 60.0) -> None:
+        """Respawn one shard now (fresh incarnation; recovers from its
+        checkpoint chain) and republish endpoints."""
+        s = self.shards[shard_id]
+        s.spawn()
+        if not s.wait_announce(timeout):
+            raise TimeoutError(
+                f"respawned shard {shard_id} never announced"
+            )
+        self.respawns += 1
+        self.write_endpoints()
+        self._event("replay_shard_respawned", shard=shard_id,
+                    incarnation=s.incarnation, port=s.port)
+
+    def kill(self, shard_id: int) -> dict:
+        s = self.shards[shard_id]
+        pid = s.pid
+        s.kill()
+        self.kills += 1
+        rec = {"fault": "kill_shard", "shard": shard_id, "pid": pid}
+        self._event("replay_shard_killed", **rec)
+        return rec
+
+    def kill_random(self, rng=None) -> dict:
+        rng = rng or self._chaos_rng
+        live = [s.shard_id for s in self.shards if s.alive()]
+        if not live:
+            return {"fault": "kill_shard", "skipped": "no live shards"}
+        return self.kill(live[rng.randrange(len(live))])
+
+    def maybe_kill_at_step(self, step: int) -> Optional[dict]:
+        """The ``chaos.kill_shard_at_step`` drill: fire once, seeded
+        victim, when the driver's step counter first crosses the mark."""
+        if not self._kill_at_step or self._kill_fired \
+                or step < self._kill_at_step:
+            return None
+        self._kill_fired = True
+        return self.kill_random()
+
+    def _supervise_loop(self) -> None:
+        from ape_x_dqn_tpu.runtime.supervisor import QUARANTINE, RESPAWN
+
+        reported: set = set()
+        while not self._stop.wait(0.1):
+            for s in self.shards:
+                sid = s.shard_id
+                if s.alive() or sid in self.quarantined:
+                    reported.discard(sid)
+                    continue
+                if sid not in reported:
+                    reported.add(sid)
+                    if self._respawn_policy.on_death(sid) == QUARANTINE:
+                        self.quarantined.add(sid)
+                        self._event("replay_shard_quarantined", shard=sid)
+                        continue
+                if self._respawn_policy.decide(sid) == RESPAWN:
+                    try:
+                        self.respawn(sid)
+                        reported.discard(sid)
+                    except (TimeoutError, OSError) as e:
+                        self._event("replay_shard_respawn_failed",
+                                    shard=sid, error=str(e))
+                        self._respawn_policy.on_death(sid)
+
+    def stats(self) -> dict:
+        return {
+            "shards": self.num_shards,
+            "alive": sum(1 for s in self.shards if s.alive()),
+            "respawns": self.respawns,
+            "kills": self.kills,
+            "quarantined": sorted(self.quarantined),
+            "incarnations": {
+                str(s.shard_id): s.incarnation for s in self.shards
+            },
+        }
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        for s in self.shards:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Shard CLI: `python -m ape_x_dqn_tpu.replay.service --shard-id K ...`
+# ---------------------------------------------------------------------------
+
+
+def _emit_line(**fields) -> None:
+    sys.stdout.write(json.dumps(fields) + "\n")
+    sys.stdout.flush()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="replay-shard", description=__doc__)
+    ap.add_argument("--shard-id", type=int, required=True)
+    ap.add_argument("--capacity", type=int, required=True)
+    ap.add_argument("--obs-shape", required=True,
+                    help="comma-separated, e.g. 84,84,1")
+    ap.add_argument("--alpha", type=float, default=0.6)
+    ap.add_argument("--token", type=int, default=0)
+    ap.add_argument("--incarnation", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--codec", default="zlib", choices=("off", "zlib"))
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--save-every-s", type=float, default=2.0)
+    ap.add_argument("--base-every", type=int, default=16)
+    ap.add_argument("--max-request-bytes", type=int,
+                    default=_DEFAULT_MAX_FRAME)
+    ap.add_argument("--rpc-delay-ms", type=float, default=0.0)
+    ap.add_argument("--rpc-drop-rate", type=float, default=0.0)
+    ap.add_argument("--chaos-seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from ape_x_dqn_tpu.replay.buffer import PrioritizedReplay
+
+    obs_shape = tuple(int(d) for d in args.obs_shape.split(","))
+    replay = PrioritizedReplay(args.capacity, obs_shape,
+                               priority_exponent=args.alpha)
+    # Recovery: a respawned incarnation walks its own chain back to the
+    # newest committed state — bit-exact (digest announced below) or a
+    # typed degraded_restore from the fallback rungs, never silent.
+    restored_step = None
+    if args.ckpt_dir:
+        from ape_x_dqn_tpu.utils.checkpoint_inc import (
+            load_incremental_replay,
+        )
+
+        try:
+            restored_step = load_incremental_replay(
+                args.ckpt_dir, replay, fallback=True,
+                on_event=lambda ev: _emit_line(**ev),
+            )
+        except Exception as e:  # noqa: BLE001 — typed failure, never silent
+            _emit_line(event="replay_shard_restore_failed",
+                       shard=args.shard_id,
+                       error=f"{type(e).__name__}: {e}")
+            return 2
+        if restored_step is not None:
+            d = replay.digest(with_crc=True)
+            _emit_line(event="replay_shard_recovered", shard=args.shard_id,
+                       incarnation=args.incarnation, step=restored_step,
+                       **d)
+    chaos = None
+    if args.rpc_delay_ms or args.rpc_drop_rate:
+        from ape_x_dqn_tpu.obs.chaos import RpcChaos
+
+        chaos = RpcChaos(delay_ms=args.rpc_delay_ms,
+                         drop_rate=args.rpc_drop_rate,
+                         seed=args.chaos_seed)
+    server = ReplayShardServer(
+        replay, args.shard_id, incarnation=args.incarnation,
+        token=args.token, host=args.host, port=args.port, codec=args.codec,
+        max_request_bytes=args.max_request_bytes,
+        ckpt_dir=args.ckpt_dir or None, save_every_s=args.save_every_s,
+        base_every=args.base_every, chaos=chaos,
+        on_event=lambda kind, **f: _emit_line(event=kind, **f),
+    )
+    server.start()
+    _emit_line(event="replay_shard_listen", shard=args.shard_id,
+               incarnation=args.incarnation, port=server.port,
+               pid=os.getpid(), capacity=args.capacity,
+               restored_step=restored_step)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    while not stop.wait(0.25):
+        pass
+    server.close()
+    _emit_line(event="replay_shard_stopped", shard=args.shard_id,
+               **{k: v for k, v in server.stats().items()
+                  if k in ("requests", "torn_frames", "add_dups")})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

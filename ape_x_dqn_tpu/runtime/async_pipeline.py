@@ -502,6 +502,21 @@ class AsyncPipeline:
             # learner's critical path (the stager/writer discipline).
             self._tier_evictor = TierEvictor(_tier_replay)
         self.health = Health(stale_after_s=ocfg.heartbeat_stale_s)
+        # Replay-as-a-service client (replay/service.py): its degradation
+        # surface rides the registry (`replay_svc` provider on /varz +
+        # the JSONL section below) and /healthz — a down shard is a
+        # DEGRADED component and buffered write-backs, never a wedge.
+        self._remote_replay = None
+        if self.comps.replay is not None \
+                and getattr(self.comps.replay, "remote", False):
+            self._remote_replay = self.comps.replay
+            self.obs_registry.register_provider(
+                "replay_svc", self._remote_replay.stats
+            )
+            self.health.register("replay_svc", self._remote_replay.age_s)
+            self.register_jsonl_section(
+                "replay_svc", self._remote_replay.stats
+            )
         self._postmortem_dir = self._resolve_postmortem_dir()
         self.recorder = FlightRecorder(
             "trainer", depth=ocfg.recorder_depth
@@ -1293,10 +1308,13 @@ class AsyncPipeline:
                     )
                 barrier("replay-shards-before-state-commit")
             if self._proc_idx == 0:
+                # Service-attached replay: the shards own their chains —
+                # only the train-state leg saves here.
                 save_checkpoint(
                     cfg.learner.checkpoint_dir,
                     host_state,
-                    replay=self.comps.replay,
+                    replay=(None if self._remote_replay is not None
+                            else self.comps.replay),
                     replay_suffix=sfx,
                 )
         # Learner-visible checkpoint stall — the number the incremental
@@ -1379,6 +1397,14 @@ class AsyncPipeline:
             except Exception:  # noqa: BLE001 — teardown best-effort
                 pass
             self.obs_server = None
+        if self._remote_replay is not None:
+            # Stop the probe thread and release the RPC sockets (fd-leak
+            # guard discipline).  Soft close: a later op on the client
+            # simply reconnects — only background recovery stops.
+            try:
+                self._remote_replay.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
 
     def register_jsonl_section(self, name: str, fn) -> None:
         """Fold ``fn()`` into every periodic emit as section ``name`` —

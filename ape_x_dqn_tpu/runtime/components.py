@@ -267,6 +267,29 @@ def build_components(cfg: ApexConfig) -> Components:
         # Throughput mode keeps the ring in HBM (make_fused_learner); the
         # host replay would be ~capacity × 2 frames of dead host RAM.
         replay = None
+    elif cfg.replay.service_mode == "attach":
+        # Replay as a service (replay/service.py): the "replay" is a
+        # retrying RPC client over the shard fleet named by the endpoints
+        # file — same add/sample/update_priorities surface, but the
+        # learner's sample path now SURVIVES a replay process dying
+        # (typed degradation + write-back buffering instead of a wedge).
+        from ape_x_dqn_tpu.replay.service import ShardedReplayClient
+
+        replay = ShardedReplayClient.from_endpoints_file(
+            cfg.replay.service_endpoints,
+            codec=cfg.replay.service_codec,
+            dedup=cfg.replay.service_dedup,
+            request_timeout_s=cfg.replay.service_request_timeout_s,
+            probe_interval_s=cfg.replay.service_probe_interval_s,
+            seed=cfg.seed,
+        )
+        if replay.capacity != cfg.replay.capacity:
+            raise ValueError(
+                f"replay.capacity {cfg.replay.capacity} != the service "
+                f"fleet's total {replay.capacity} "
+                f"({cfg.replay.service_endpoints}) — the slot-index "
+                "arithmetic (lineage, priority routing) must agree"
+            )
     elif cfg.replay.dedup:
         from ape_x_dqn_tpu.replay import DedupReplay
 
@@ -304,8 +327,12 @@ def build_components(cfg: ApexConfig) -> Components:
 
         suffix = replay_shard_suffix()
         try:
+            # Remote (service-attached) replay: the shards own their own
+            # chains — only the train-state leg restores here.
             state, learner_step = restore_checkpoint(
-                restore_path, state, replay=replay, replay_suffix=suffix
+                restore_path, state,
+                replay=None if getattr(replay, "remote", False) else replay,
+                replay_suffix=suffix,
             )
             restored_path = restore_path
             print(f"restored checkpoint at step {learner_step}")

@@ -115,6 +115,14 @@ F_SREQ = 16        # client → server: one observation to act on
 F_SREP = 17        # server → client: greedy action + evidence
 F_SERR = 18        # server → client: typed refusal (shed / closed / bad)
 
+# Replay-service RPC kinds (replay/service.py) — the replay plane is the
+# third protocol on this frame discipline: sample/add/update-priorities/
+# digest between a learner and a replay shard, torn/bitflipped/oversize/
+# out-of-seq frames counted and never decoded exactly like the other two.
+F_RREQ = 32        # learner → shard: one replay RPC request
+F_RREP = 33        # shard → learner: reply
+F_RERR = 34        # shard → learner: typed refusal (bad / empty / closed)
+
 # F_SERR error codes.
 E_OVERLOADED = 1   # admission control shed the request (retry later)
 E_CLOSED = 2       # server shutting down

@@ -574,6 +574,13 @@ class ProcessActorPool:
         self._NStepTransition = NStepTransition
         self.cfg = cfg
         self.num_workers = int(num_workers)
+        # Remote-worker slots (actor.remote_workers; tools/host_join.py):
+        # extra wids beyond the local fleet, carved from the SAME global
+        # actor partition.  The pool pre-registers their channels and
+        # publishes a join spec; it never spawns or supervises them — a
+        # quiet remote channel is degradation, not a death.
+        self.remote_workers = int(getattr(cfg.actor, "remote_workers", 0))
+        self.total_workers = self.num_workers + self.remote_workers
         self._queue_size = int(queue_size)
         self._ring_bytes = int(
             ring_bytes if ring_bytes is not None else cfg.actor.xp_ring_bytes
@@ -589,7 +596,7 @@ class ProcessActorPool:
         # (shm) or delta/full frames on the experience connections (tcp,
         # NetParamStore).
         self._transport = make_transport(
-            cfg, self.num_workers, self._ring_bytes, self._drain_budget
+            cfg, self.total_workers, self._ring_bytes, self._drain_budget
         )
         if self._transport.kind == "tcp":
             self.buffer = None
@@ -689,7 +696,7 @@ class ProcessActorPool:
             stats_name = None
         p = self._ctx.Process(
             target=_worker_main,
-            args=(wid, self._cfg_dict, self.num_workers, param_spec,
+            args=(wid, self._cfg_dict, self.total_workers, param_spec,
                   xp_spec, self._queues[wid], self.stop_event,
                   budget, self._quantum, attempt, self._seed_base,
                   self.cfg.actor.worker_nice, stats_name),
@@ -877,6 +884,54 @@ class ProcessActorPool:
             self._procs.append(self._spawn(w, self.cfg.actor.T))
             if stagger and w + 1 < self.num_workers:
                 time.sleep(stagger)
+        if self.remote_workers:
+            self.register_remote_workers()
+
+    def register_remote_workers(self, path: Optional[str] = None) -> str:
+        """Reserve channels for the ``actor.remote_workers`` externally-
+        launched workers and publish the join spec (atomic tmp+rename
+        JSON) that ``tools/host_join.py`` consumes: one endpoint spec per
+        remote wid (host/port/per-run token/attempt + the wire-efficiency
+        knobs), the full run config, and the global partition arithmetic,
+        so a whole host attaches with one command and its actors land on
+        exactly the slices this fleet reserved for them.
+
+        Remote wids are never spawned or supervised here — their channels
+        ride the normal poll sweep (reconnects handled by NetChannel),
+        and a silent remote worker is degradation the operator sees on
+        ``net.connections < net.expected``, not a pool fatal."""
+        if self._transport.kind != "tcp":
+            raise RuntimeError(
+                "remote workers require actor.transport=tcp"
+            )
+        path = path or self.cfg.actor.remote_join_path
+        if not path:
+            raise RuntimeError("actor.remote_join_path is empty")
+        specs = []
+        for k in range(self.remote_workers):
+            wid = self.num_workers + k
+            if wid not in self._rings:
+                self._attempt[wid] = 1   # attempt 0 is the joinable one
+                self._rings[wid] = self._transport.make_channel(wid, 0)
+            specs.append(self._transport.endpoint(self._rings[wid], wid, 0))
+        import json as _json
+
+        doc = {
+            "cfg": self._cfg_dict,
+            "num_workers_total": self.total_workers,
+            "num_local_workers": self.num_workers,
+            "quantum": self._quantum,
+            "seed_base": self._seed_base,
+            "budget": int(self.cfg.actor.T),
+            "specs": specs,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
 
     def supervise(self) -> None:
         """Respawn dead workers (SURVEY §5 failure detection: actors are
@@ -1091,7 +1146,9 @@ class ProcessActorPool:
 
     def _worker_width(self, wid: int) -> int:
         """Actors in worker ``wid``'s slice of the global set."""
-        lo, hi = worker_slice(wid, self.cfg.actor.num_actors, self.num_workers)
+        lo, hi = worker_slice(
+            wid, self.cfg.actor.num_actors, self.total_workers
+        )
         return hi - lo
 
     def stop(self, join_timeout: float = 15.0):

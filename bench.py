@@ -620,6 +620,32 @@ def _host_dedup_bench(capacity: int = 2_000_000, iters: int = 2000,
     }
 
 
+def _replay_svc_bench(iters: int = 300, batch: int = 32,
+                      capacity: int = 16_384, rows: int = 8_192,
+                      timeout_s: float = 420.0) -> dict:
+    """``replay_svc``: tools/replay_svc_bench.py in a CPU-pinned
+    subprocess (the ``serving_qps`` isolation pattern) — RPC sample vs
+    in-process sample at the Atari frame shape, with the codec-off /
+    codec-zlib split and the dedup wire economy on the add path
+    (ROADMAP item 1's bench leg; committed: demos/replay_svc.json)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "replay_svc_bench.py"),
+         "--iters", str(iters), "--batch", str(batch),
+         "--capacity", str(capacity), "--rows", str(rows)],
+        capture_output=True, text=True, timeout=timeout_s, env=env,
+        cwd=repo,
+    )
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip()[-400:]
+        raise RuntimeError(f"replay_svc_bench rc={proc.returncode}: {tail}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def _replay_tiered_bench(capacity: int = 200_000, iters: int = 1000,
                          hot_frac: float = 0.25,
                          workdir: str | None = None) -> dict:
@@ -1180,6 +1206,12 @@ def main() -> None:
                         help="comma-separated producer counts for "
                         "xp_transport")
     parser.add_argument("--xp-seconds", type=float, default=3.0)
+    parser.add_argument("--skip-replay-svc", action="store_true",
+                        help="skip the replay-as-a-service RPC vs "
+                        "in-process section")
+    parser.add_argument("--replay-svc-iters", type=int, default=300)
+    parser.add_argument("--replay-svc-capacity", type=int, default=16_384)
+    parser.add_argument("--replay-svc-rows", type=int, default=8_192)
     parser.add_argument("--skip-replay-tiered", action="store_true",
                         help="skip the replay_tiered section (disk-spill "
                         "cold frame store vs in-core)")
@@ -1330,6 +1362,16 @@ def main() -> None:
         section("replay_tiered", _replay_tiered_bench,
                 capacity=args.replay_tiered_capacity,
                 iters=args.replay_tiered_iters)
+    if not args.skip_replay_svc:
+        # Host-only (CPU-pinned subprocess; no jax anywhere in it): the
+        # replay-as-a-service RPC plane vs in-process sampling — what
+        # moving the replay out of the learner's address space costs per
+        # batch (ROADMAP item 1; demos/replay_svc.json is the committed
+        # point set).
+        section("replay_svc", _replay_svc_bench,
+                iters=args.replay_svc_iters,
+                capacity=args.replay_svc_capacity,
+                rows=args.replay_svc_rows)
     if not args.skip_ckpt_stall:
         # Host-only: learner-visible checkpoint stall, full-sync vs the
         # incremental async subsystem, at the 2M-slot dedup layout.

@@ -35,6 +35,9 @@ def test_bench_emits_host_only_json_during_outage():
         "--ckpt-interval-rows", "4096",
         "--pipeline-overlap-steps", "1024",  # tiny: mechanism, not scale
         "--pipeline-overlap-sync-every", "256",
+        "--replay-svc-iters", "30",          # tiny: mechanism, not scale
+        "--replay-svc-capacity", "2048",
+        "--replay-svc-rows", "1024",
     ]
     proc = subprocess.run(
         cmd, capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
@@ -49,8 +52,13 @@ def test_bench_emits_host_only_json_during_outage():
     assert rec["backend_probe"]["error"]
     # Host-only sections survive the outage...
     for key in ("host_replay_2m", "host_dedup_2m", "serving_qps",
-                "xp_transport", "checkpoint_stall", "pipeline_overlap"):
+                "xp_transport", "checkpoint_stall", "pipeline_overlap",
+                "replay_svc"):
         assert key in rec, f"missing host-only section {key}"
+    rs = rec["replay_svc"]
+    assert "error" not in rs, rs
+    assert rs["in_process"]["samples_per_s"] > 0
+    assert rs["rpc_1shard"]["samples_per_s"] > 0
     po = rec["pipeline_overlap"]
     assert "error" not in po, po
     assert po["points"]["depth4"]["inflight_at_exit"] == 0

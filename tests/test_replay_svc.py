@@ -1,0 +1,755 @@
+"""Replay-as-a-service: the adversarial RPC matrix + degradation
+contract (replay/service.py).
+
+The replay plane inherits the experience transport's decode discipline —
+torn/bitflipped/oversize/out-of-seq frames counted and NEVER decoded —
+and adds the service-level contracts on top: stale-incarnation hello
+rejection, per-request deadlines with whole-request retry, at-most-once
+adds under lost replies, write-back buffering while a shard is down, and
+restart-under-load recovery through the shard's own checkpoint chain.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.replay.buffer import PrioritizedReplay
+from ape_x_dqn_tpu.replay.service import (
+    _RERR,
+    _RPC,
+    _SAMPLE_REQ,
+    FLAG_DUP,
+    OP_ADD,
+    OP_DIGEST,
+    OP_SAMPLE,
+    RSVC_ACK,
+    RSVC_ACK_MAGIC,
+    RSVC_HELLO,
+    RSVC_MAGIC,
+    RSVC_VERSION,
+    ReplayShardServer,
+    ReplayShardUnavailable,
+    ShardClient,
+    ShardedReplayClient,
+    decode_body,
+    encode_body,
+)
+from ape_x_dqn_tpu.runtime.net import CODEC_OFF, CODEC_ZLIB, F_RREQ, frame_bytes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OBS = (6,)
+
+
+def _chunk(n=8, seed=0, overlap=False):
+    r = np.random.default_rng(seed)
+    obs = r.integers(0, 255, (n, *OBS), dtype=np.uint8)
+    arrays = {
+        "prio": (np.abs(r.normal(size=n)) + 0.1).astype(np.float64),
+        "obs": obs,
+        "action": r.integers(0, 2, n).astype(np.int32),
+        "reward": r.normal(size=n).astype(np.float32),
+        "discount": np.full(n, 0.99, np.float32),
+        # n-step overlap shape: next_obs[i] == obs[i+1] — the dedup
+        # encoder's target redundancy.
+        "next_obs": (np.roll(obs, -1, axis=0) if overlap
+                     else r.integers(0, 255, (n, *OBS), dtype=np.uint8)),
+    }
+    return arrays
+
+
+class _Batch:
+    def __init__(self, arrays):
+        for k, v in arrays.items():
+            if k != "prio":
+                setattr(self, k, v)
+        self.prio = arrays["prio"]
+
+
+@pytest.fixture
+def shard():
+    rep = PrioritizedReplay(256, OBS, priority_exponent=0.6)
+    srv = ReplayShardServer(rep, 0, incarnation=2, token=777,
+                            codec="zlib").start()
+    yield rep, srv
+    srv.close()
+
+
+def _client_for(srv, **kw):
+    kw.setdefault("request_timeout_s", 5.0)
+    return ShardedReplayClient(
+        [{"id": 0, "host": "127.0.0.1", "port": srv.port, "base": 0,
+          "capacity": srv.replay.capacity,
+          "incarnation": srv.incarnation}],
+        token=srv.token, **kw,
+    )
+
+
+def _raw_conn(srv, incarnation=None, token=None, codec=CODEC_ZLIB,
+              client_id=9):
+    """Handshake a raw socket (returns it past the ack)."""
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=5.0)
+    s.sendall(RSVC_HELLO.pack(
+        RSVC_MAGIC, RSVC_VERSION, client_id, srv.shard_id,
+        srv.incarnation if incarnation is None else incarnation,
+        srv.token if token is None else token, codec,
+    ))
+    s.settimeout(5.0)
+    ack = b""
+    while len(ack) < RSVC_ACK.size:
+        got = s.recv(RSVC_ACK.size - len(ack))
+        if not got:
+            s.close()
+            return None
+        ack += got
+    assert RSVC_ACK.unpack(ack)[0] == RSVC_ACK_MAGIC
+    return s
+
+
+def _wait(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# Body codec.
+# ---------------------------------------------------------------------------
+
+
+class TestBodyCodec:
+    def test_round_trip_bit_exact(self):
+        arrays = _chunk(seed=1)
+        body = encode_body(arrays, codec=CODEC_ZLIB, dedup=True)
+        out = decode_body(body)
+        for k, v in arrays.items():
+            np.testing.assert_array_equal(out[k], v)
+
+    def test_dedup_shrinks_overlapping_chunks(self):
+        # Frames must be >= the dedup span floor (64 B) to dedup; (6,)
+        # obs are below it, so use a frame-shaped chunk here.
+        r = np.random.default_rng(3)
+        obs = r.integers(0, 255, (16, 12, 12, 1), dtype=np.uint8)
+        dense = {
+            "prio": np.ones(16), "obs": obs,
+            "action": np.zeros(16, np.int32),
+            "reward": np.zeros(16, np.float32),
+            "discount": np.ones(16, np.float32),
+            "next_obs": np.roll(obs, -1, axis=0),
+        }
+        plain = encode_body(dense, codec=CODEC_OFF, dedup=False)
+        deduped = encode_body(dense, codec=CODEC_OFF, dedup=True)
+        assert len(deduped) < 0.7 * len(plain)
+        out = decode_body(deduped)
+        np.testing.assert_array_equal(out["next_obs"], dense["next_obs"])
+
+    def test_malformed_bodies_raise(self):
+        body = encode_body(_chunk(), codec=CODEC_OFF, dedup=False)
+        with pytest.raises(ValueError):
+            decode_body(body[:len(body) // 2])
+        with pytest.raises(ValueError):
+            decode_body(bytes((9,)) + body[1:])      # unknown codec byte
+        zbody = encode_body(_chunk(), codec=CODEC_ZLIB, dedup=False)
+        if zbody[0] == 1:  # compressed payload on an off-codec connection
+            with pytest.raises(ValueError):
+                decode_body(zbody, allow_zlib=False)
+
+
+# ---------------------------------------------------------------------------
+# Adversarial frames against a live shard.
+# ---------------------------------------------------------------------------
+
+
+class TestShardAdversarial:
+    def test_truncated_request_frame_torn_never_applied(self, shard):
+        rep, srv = shard
+        s = _raw_conn(srv)
+        payload = _RPC.pack(1, OP_ADD) + encode_body(_chunk())
+        frame = frame_bytes(F_RREQ, 1, [payload])
+        s.sendall(frame[:len(frame) - 7])     # cut mid-payload
+        s.close()                             # disconnect mid-frame
+        _wait(lambda: srv.torn_frames >= 1, msg="torn count")
+        assert rep.total_added == 0           # never decoded, never applied
+        assert srv.ops["add"] == 0
+
+    def test_bitflipped_request_frame_torn(self, shard):
+        rep, srv = shard
+        s = _raw_conn(srv)
+        payload = _RPC.pack(1, OP_ADD) + encode_body(_chunk())
+        frame = bytearray(frame_bytes(F_RREQ, 1, [payload]))
+        frame[40] ^= 0x10                     # flip a payload byte: crc fails
+        s.sendall(bytes(frame))
+        _wait(lambda: srv.torn_frames >= 1, msg="crc torn")
+        assert rep.total_added == 0
+        s.close()
+
+    def test_oversize_prefix_torn(self, shard):
+        _rep, srv = shard
+        s = _raw_conn(srv)
+        # A length prefix past max_request_bytes must fail BEFORE the
+        # server buffers it.
+        s.sendall(struct.pack("<IIqB7x", (1 << 30) + 5, 0, 1, F_RREQ))
+        _wait(lambda: srv.torn_frames >= 1, msg="oversize torn")
+        s.close()
+
+    def test_out_of_seq_frame_torn(self, shard):
+        _rep, srv = shard
+        s = _raw_conn(srv)
+        payload = _RPC.pack(1, OP_DIGEST)
+        s.sendall(frame_bytes(F_RREQ, 3, [payload]))   # seq must start at 1
+        _wait(lambda: srv.torn_frames >= 1, msg="seq torn")
+        s.close()
+
+    def test_wrong_kind_frame_torn(self, shard):
+        _rep, srv = shard
+        from ape_x_dqn_tpu.runtime.net import F_RREP
+
+        s = _raw_conn(srv)
+        s.sendall(frame_bytes(F_RREP, 1, [b"x"]))      # replies never flow in
+        _wait(lambda: srv.torn_frames >= 1, msg="kind torn")
+        s.close()
+
+    def test_bad_hello_rejected_before_framing(self, shard):
+        _rep, srv = shard
+        assert _raw_conn(srv, token=123456) is None    # wrong run token
+        _wait(lambda: srv.bad_hellos >= 1, msg="bad hello")
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5.0)
+        s.sendall(b"GARBAGEGARBAGEGARBAGEGARBAGEGARBAGEGARBAGEJUNK!!")
+        _wait(lambda: srv.bad_hellos >= 2, msg="garbage hello")
+        s.close()
+        assert srv.torn_frames == 0           # rejected pre-framing
+
+    def test_stale_incarnation_hello_rejected(self, shard):
+        _rep, srv = shard
+        assert _raw_conn(srv, incarnation=srv.incarnation - 1) is None
+        _wait(lambda: srv.stale_rejects >= 1, msg="stale reject")
+        assert _raw_conn(srv, incarnation=-1) is not None  # "current" ok
+
+    def test_well_framed_garbage_is_typed_not_torn(self, shard):
+        rep, srv = shard
+        s = _raw_conn(srv)
+        s.sendall(frame_bytes(F_RREQ, 1,
+                              [_RPC.pack(7, OP_ADD) + b"\x00garbage"]))
+        deadline = time.monotonic() + 5.0
+        buf = b""
+        while time.monotonic() < deadline and len(buf) < 24:
+            buf += s.recv(1 << 16)
+        # A typed F_RERR reply came back; the stream is NOT torn.
+        assert srv.errors >= 1
+        assert srv.torn_frames == 0
+        assert rep.total_added == 0
+        s.close()
+
+    def test_bitflipped_reply_frame_torn_client_side(self, shard):
+        """A corrupted REPLY stream is dropped client-side (counted on
+        rpc_torn) and the request retries on a fresh connection."""
+        rep, srv = shard
+        # Seed the shard so samples answer.
+        cl = _client_for(srv)
+        cl.add(_chunk()["prio"], _Batch(_chunk(seed=5)))
+        cl.close()
+
+        # Man-in-the-middle proxy that flips one byte of the first reply.
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(4)
+        pport = lsock.getsockname()[1]
+        flipped = threading.Event()
+
+        def proxy():
+            while True:
+                try:
+                    a, _ = lsock.accept()
+                except OSError:
+                    return
+                b = socket.create_connection(("127.0.0.1", srv.port))
+
+                def pump(src, dst, corrupt):
+                    try:
+                        while True:
+                            d = src.recv(1 << 16)
+                            if not d:
+                                break
+                            if corrupt and not flipped.is_set() \
+                                    and len(d) > RSVC_ACK.size + 40:
+                                d = bytearray(d)
+                                d[RSVC_ACK.size + 30] ^= 0x40
+                                d = bytes(d)
+                                flipped.set()
+                            dst.sendall(d)
+                    except OSError:
+                        pass
+                    for x in (src, dst):
+                        try:
+                            x.close()
+                        except OSError:
+                            pass
+
+                threading.Thread(target=pump, args=(a, b, False),
+                                 daemon=True).start()
+                threading.Thread(target=pump, args=(b, a, True),
+                                 daemon=True).start()
+
+        t = threading.Thread(target=proxy, daemon=True)
+        t.start()
+        sc = ShardClient(0, "127.0.0.1", pport, token=srv.token,
+                         client_id=31, incarnation=-1)
+        _flags, rep_body = sc.request(
+            OP_SAMPLE, _SAMPLE_REQ.pack(4, 0.4, 17), timeout=15.0
+        )
+        assert rep_body                       # answered despite the flip
+        assert flipped.is_set()
+        assert sc.torn >= 1 or sc.reconnects >= 1
+        sc.close()
+        lsock.close()
+
+
+# ---------------------------------------------------------------------------
+# Retry discipline + at-most-once adds.
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedChaos:
+    """Drop exactly the scripted requests (deterministic lost-reply)."""
+
+    def __init__(self, drops):
+        self._drops = list(drops)
+
+    def delay_s(self):
+        return 0.0
+
+    def drop(self):
+        return self._drops.pop(0) if self._drops else False
+
+
+class TestRetryAndIdempotence:
+    def test_deadline_expiry_is_typed(self):
+        cl = ShardClient(0, "127.0.0.1", 1, token=1, client_id=1)
+        t0 = time.monotonic()
+        with pytest.raises(ReplayShardUnavailable) as ei:
+            cl.request(OP_DIGEST, timeout=0.6)
+        assert time.monotonic() - t0 < 5.0
+        assert ei.value.shard_id == 0 and ei.value.op == "digest"
+        cl.close()
+
+    def test_drop_then_retry_applies_exactly_once(self):
+        rep = PrioritizedReplay(256, OBS)
+        srv = ReplayShardServer(rep, 0, token=5,
+                                chaos=_ScriptedChaos([True]))
+        srv.start()
+        try:
+            sc = ShardClient(0, "127.0.0.1", srv.port, token=5,
+                             client_id=3, io_timeout_s=0.5)
+            arrays = _chunk(seed=9)
+            body = encode_body(arrays, codec=CODEC_ZLIB)
+            # First send is dropped shard-side (no reply) → the io
+            # timeout forces a whole-request retry with the SAME req_id.
+            flags, rep_body = sc.request(OP_ADD, body, timeout=20.0)
+            assert sc.retries >= 1
+            assert rep.total_added == 8       # applied exactly once
+            assert srv.chaos_dropped == 1
+            sc.close()
+        finally:
+            srv.close()
+
+    def test_duplicate_add_served_from_cache(self, shard):
+        rep, srv = shard
+        sc = ShardClient(0, "127.0.0.1", srv.port, token=srv.token,
+                         client_id=4)
+        body = encode_body(_chunk(seed=11), codec=CODEC_ZLIB)
+        rid = sc.next_req_id()
+        flags1, rep1 = sc.request(OP_ADD, body, req_id=rid)
+        flags2, rep2 = sc.request(OP_ADD, body, req_id=rid)   # replay it
+        assert flags1 == 0 and flags2 == FLAG_DUP
+        assert rep1 == rep2                   # byte-identical cached reply
+        assert rep.total_added == 8           # at-most-once
+        assert srv.add_dups == 1
+        sc.close()
+
+    def test_backoff_resets_only_on_verified_reply(self, shard):
+        _rep, srv = shard
+        sc = ShardClient(0, "127.0.0.1", 1, token=srv.token, client_id=5)
+        with pytest.raises(ReplayShardUnavailable):
+            sc.request(OP_DIGEST, timeout=0.8)
+        fails_after_dead = sc._backoff._fails
+        assert fails_after_dead >= 1
+        sc.host, sc.port = "127.0.0.1", srv.port
+        sc._backoff.reset()   # endpoint re-resolve resets pacing
+        sc.request(OP_DIGEST, timeout=5.0)
+        assert sc._backoff._fails == 0
+
+
+# ---------------------------------------------------------------------------
+# Fleet client degradation: down-shard routing, write-back buffering,
+# recovery flush, stale-incarnation re-resolve.
+# ---------------------------------------------------------------------------
+
+
+class TestShardedDegradation:
+    def _two_shards(self, tmp_path=None):
+        reps = [PrioritizedReplay(128, OBS) for _ in range(2)]
+        srvs = [ReplayShardServer(r, k, incarnation=0, token=99)
+                for k, r in enumerate(reps)]
+        for s in srvs:
+            s.start()
+        cl = ShardedReplayClient(
+            [{"id": k, "host": "127.0.0.1", "port": s.port, "base": 128 * k,
+              "capacity": 128, "incarnation": 0}
+             for k, s in enumerate(srvs)],
+            token=99, request_timeout_s=1.5, probe_interval_s=0.2,
+        )
+        return reps, srvs, cl
+
+    def test_survivor_keeps_serving_and_writebacks_flush(self):
+        reps, srvs, cl = self._two_shards()
+        try:
+            # Fill both shards.
+            for seed in range(6):
+                cl.add(_chunk(seed=seed)["prio"], _Batch(_chunk(seed=seed)))
+            batch = cl.sample(8, rng=np.random.default_rng(0))
+            assert cl.size() == reps[0].size() + reps[1].size()
+
+            # Kill shard 1 (its slot range is [128, 256)).
+            port1 = srvs[1].port
+            srvs[1].close()
+            idx1 = np.arange(130, 138)
+            cl.update_priorities(idx1, np.full(8, 9.0))
+            _wait(lambda: 1 in cl._down or cl.stats()["writeback_pending"],
+                  msg="shard 1 marked down")
+            st = cl.stats()
+            assert st["writeback_pending"] >= 1
+            assert st["degraded"] and st["shards_down"] == 1
+
+            # Sampling and adding keep working against the survivor.
+            for _ in range(4):
+                b = cl.sample(8, rng=np.random.default_rng(1))
+                assert b.indices.max() < 128   # survivor's range only
+            idx = cl.add(_chunk(seed=31)["prio"], _Batch(_chunk(seed=31)))
+            assert idx.max() < 128
+
+            # Respawn shard 1 on the SAME port with a fresh incarnation;
+            # the probe must flush the parked write-backs, then recover.
+            srvs[1] = ReplayShardServer(reps[1], 1, incarnation=1,
+                                        token=99, port=port1).start()
+            cl._clients[1].set_endpoint("127.0.0.1", port1, 1)
+            _wait(lambda: not cl.degraded, msg="recovery")
+            st = cl.stats()
+            assert st["writeback_pending"] == 0
+            assert st["writeback_flushed"] >= 8
+            assert st["recoveries"] >= 1
+            # Last-write-wins landed: mass at the written slots moved.
+            m = reps[1]._tree.get(np.arange(2, 10))
+            np.testing.assert_allclose(m, 9.0 ** 0.6, rtol=1e-9)
+            del batch
+        finally:
+            cl.close()
+            for s in srvs:
+                s.close()
+
+    def test_all_down_is_typed(self):
+        reps, srvs, cl = self._two_shards()
+        try:
+            cl.add(_chunk()["prio"], _Batch(_chunk()))
+            for s in srvs:
+                s.close()
+            with pytest.raises(ReplayShardUnavailable):
+                for _ in range(3):
+                    cl.sample(4, rng=np.random.default_rng(2))
+            assert cl.degraded and cl.age_s() >= 0.0
+        finally:
+            cl.close()
+            for s in srvs:
+                s.close()
+
+    def test_stale_incarnation_reresolves_via_endpoints_file(self, tmp_path):
+        rep = PrioritizedReplay(128, OBS)
+        srv = ReplayShardServer(rep, 0, incarnation=0, token=7).start()
+        ep = tmp_path / "endpoints.json"
+
+        def write_ep(port, inc):
+            doc = {"token": 7, "codec": "zlib", "total_capacity": 128,
+                   "shards": [{"id": 0, "host": "127.0.0.1", "port": port,
+                               "base": 0, "capacity": 128,
+                               "incarnation": inc}]}
+            tmp = str(ep) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, str(ep))
+
+        write_ep(srv.port, 0)
+        cl = ShardedReplayClient.from_endpoints_file(
+            str(ep), request_timeout_s=1.5, probe_interval_s=0.15,
+        )
+        try:
+            cl.add(_chunk()["prio"], _Batch(_chunk()))
+            # "Respawn" the shard: new incarnation, new port; the pinned
+            # old incarnation would be rejected even if the port matched.
+            old_port = srv.port
+            srv.close()
+            srv = ReplayShardServer(rep, 0, incarnation=1, token=7).start()
+            assert srv.port != old_port or True
+            # Drive the client into the down state.
+            with pytest.raises(ReplayShardUnavailable):
+                cl.sample(4, rng=np.random.default_rng(0))
+            write_ep(srv.port, 1)
+            time.sleep(0.05)               # distinct mtime granularity
+            os.utime(str(ep))
+            _wait(lambda: not cl.degraded, msg="re-resolve + recovery")
+            b = cl.sample(4, rng=np.random.default_rng(1))
+            assert len(b.indices) == 4
+            assert cl._clients[0].incarnation == 1
+        finally:
+            cl.close()
+            srv.close()
+
+    def test_empty_fleet_sample_raises_value_error(self):
+        reps, srvs, cl = self._two_shards()
+        try:
+            with pytest.raises(ValueError):
+                cl.sample(4, rng=np.random.default_rng(0))
+        finally:
+            cl.close()
+            for s in srvs:
+                s.close()
+
+
+# ---------------------------------------------------------------------------
+# Shard persistence: digest-verified chain recovery.
+# ---------------------------------------------------------------------------
+
+
+class TestShardRecovery:
+    def test_chain_restore_is_bit_exact_by_digest(self, tmp_path, shard):
+        rep, srv = shard
+        del srv
+        from ape_x_dqn_tpu.utils.checkpoint_inc import (
+            IncrementalCheckpointer,
+            load_incremental_replay,
+        )
+
+        ck = IncrementalCheckpointer(str(tmp_path), rep, sync=True)
+        for seed in range(4):
+            rep.add(_chunk(seed=seed)["prio"],
+                    _Batch(_chunk(seed=seed)))
+            ck.save(rep.total_added)
+        want = rep.digest(with_crc=True)
+        fresh = PrioritizedReplay(256, OBS)
+        step = load_incremental_replay(str(tmp_path), fresh, fallback=True)
+        assert step == rep.total_added
+        got = fresh.digest(with_crc=True)
+        assert got == want                    # bit-exact recovery
+
+    def test_corrupt_chain_recovery_is_typed_or_exact(self, tmp_path, shard):
+        rep, _srv = shard
+        from ape_x_dqn_tpu.obs.chaos import corrupt_chunk, pick_chunk
+        from ape_x_dqn_tpu.utils.checkpoint_inc import (
+            IncrementalCheckpointer,
+            load_incremental_replay,
+        )
+
+        ck = IncrementalCheckpointer(str(tmp_path), rep, sync=True)
+        digests = []
+        for seed in range(4):
+            rep.add(_chunk(seed=seed)["prio"], _Batch(_chunk(seed=seed)))
+            ck.save(rep.total_added)
+            digests.append(rep.digest(with_crc=True))
+        inc = os.path.join(str(tmp_path), "replay_inc")
+        path = pick_chunk(inc, prefer="delta")
+        corrupt_chunk(path, "bitflip")
+        events = []
+        fresh = PrioritizedReplay(256, OBS)
+        step = load_incremental_replay(
+            str(tmp_path), fresh, fallback=True,
+            on_event=events.append,
+        )
+        # Walked back to SOME committed rung — and that rung is bit-exact
+        # against the digest recorded when it was live.
+        assert any(e["event"] == "degraded_restore" for e in events)
+        got = fresh.digest(with_crc=True)
+        assert got in digests
+        assert got["count"] == step
+
+
+# ---------------------------------------------------------------------------
+# RpcChaos determinism + config plumbing.
+# ---------------------------------------------------------------------------
+
+
+class TestChaosPlumbing:
+    def test_rpc_chaos_is_seed_deterministic(self):
+        from ape_x_dqn_tpu.obs.chaos import RpcChaos
+
+        a = RpcChaos(delay_ms=4.0, drop_rate=0.3, seed=11)
+        b = RpcChaos(delay_ms=4.0, drop_rate=0.3, seed=11)
+        sa = [(round(a.delay_s(), 9), a.drop()) for _ in range(64)]
+        sb = [(round(b.delay_s(), 9), b.drop()) for _ in range(64)]
+        assert sa == sb
+        assert a.drops > 0
+
+    def test_chaos_config_validation(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+
+        cfg = ApexConfig()
+        cfg.chaos.rpc_drop_rate = 1.5
+        with pytest.raises(ValueError):
+            cfg.validate()
+
+    def test_service_config_validation(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+
+        cfg = ApexConfig()
+        cfg.replay.service_mode = "attach"
+        with pytest.raises(ValueError):      # endpoints required
+            cfg.validate()
+        cfg.replay.service_endpoints = "x.json"
+        cfg.validate()
+        cfg.replay.dedup = True
+        with pytest.raises(ValueError):      # dedup stays learner-local
+            cfg.validate()
+        cfg.replay.dedup = False
+        cfg.learner.checkpoint_incremental = True
+        cfg.learner.checkpoint_every = 100
+        with pytest.raises(ValueError):      # shards own the chains
+            cfg.validate()
+
+    def test_remote_worker_config_validation(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+
+        cfg = ApexConfig()
+        cfg.actor.remote_workers = 2
+        with pytest.raises(ValueError):      # needs process+tcp
+            cfg.validate()
+        cfg.actor.mode = "process"
+        cfg.actor.transport = "tcp"
+        with pytest.raises(ValueError):      # needs a join path
+            cfg.validate()
+        cfg.actor.remote_join_path = "join.json"
+        cfg.actor.num_actors = 5
+        cfg.actor.num_workers = 2
+        cfg.validate()
+
+    def test_monkey_schedules_kill_shard(self):
+        from ape_x_dqn_tpu.config import ChaosConfig
+        from ape_x_dqn_tpu.obs.chaos import ChaosMonkey
+
+        m = ChaosMonkey(ChaosConfig(enabled=True, seed=4,
+                                    kill_shard_interval_s=5.0))
+        kinds = {k for _, k in m.schedule}
+        assert kinds == {"kill_shard"}
+        # Unattached: the kind degrades to a skipped record, not a crash.
+        rec = m.execute("kill_shard")
+        assert rec["skipped"]
+
+
+# ---------------------------------------------------------------------------
+# Schema pin.
+# ---------------------------------------------------------------------------
+
+
+def _doc_keys(section_header):
+    with open(os.path.join(REPO, "docs", "METRICS.md")) as f:
+        text = f.read()
+    section = text.split(section_header, 1)[1]
+    keys = []
+    for line in section.splitlines():
+        line = line.strip()
+        if line.startswith("- `"):
+            keys.append(line.split("`")[1])
+        elif line.startswith("## "):
+            break
+    return keys
+
+
+class TestReplaySvcDocSchema:
+    def test_client_stats_match_doc(self, shard):
+        _rep, srv = shard
+        doc = _doc_keys("## Replay service schema")
+        assert doc, "Replay service schema doc section missing"
+        cl = _client_for(srv)
+        try:
+            st = cl.stats()
+            assert set(doc) == set(st), sorted(set(doc) ^ set(st))
+        finally:
+            cl.close()
+
+
+# ---------------------------------------------------------------------------
+# Restart-under-load barrage: subprocess shards + live traffic + kills.
+# ---------------------------------------------------------------------------
+
+
+class TestRestartUnderLoad:
+    def test_barrage(self, tmp_path):
+        from ape_x_dqn_tpu.replay.service import ReplayServiceFleet
+
+        fleet = ReplayServiceFleet(
+            2, 512, OBS, root_dir=str(tmp_path), save_every_s=0.5,
+            respawn_base_s=0.1, respawn_max_s=0.5,
+        )
+        fleet.start(timeout=60.0)
+        cl = ShardedReplayClient.from_endpoints_file(
+            fleet.endpoints_path, request_timeout_s=3.0,
+            probe_interval_s=0.15,
+        )
+        errors = []
+        stop = threading.Event()
+
+        def traffic():
+            r = np.random.default_rng(0)
+            seed = 0
+            while not stop.is_set():
+                seed += 1
+                try:
+                    idx = cl.add(_chunk(seed=seed)["prio"],
+                                 _Batch(_chunk(seed=seed)))
+                    b = cl.sample(8, rng=r)
+                    cl.update_priorities(
+                        b.indices, np.abs(r.normal(size=8)) + 0.1
+                    )
+                    del idx
+                except (ReplayShardUnavailable, ValueError):
+                    time.sleep(0.01)    # typed degradation: keep going
+                except Exception as e:  # noqa: BLE001 — anything else fails
+                    errors.append(e)
+                    return
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        try:
+            _wait(lambda: cl.adds >= 5, timeout=30.0, msg="traffic flowing")
+            for round_ in range(2):
+                victim = round_ % 2
+                fleet.kill(victim)
+                _wait(lambda: fleet.shards[victim].alive()
+                      and fleet.shards[victim].port is not None,
+                      timeout=60.0, msg="respawn")
+                _wait(lambda: not cl.degraded, timeout=60.0,
+                      msg="client recovery")
+            _wait(lambda: cl.adds >= 10, timeout=30.0, msg="traffic resumed")
+        finally:
+            stop.set()
+            t.join(timeout=30.0)
+            st = cl.stats()
+            cl.close()
+            fleet.stop()
+        assert not errors, errors
+        assert st["rpc_torn"] == 0            # clean streams throughout
+        assert fleet.respawns >= 2
+        assert st["recoveries"] >= 1
+        # Respawned shards recovered from their chains: both report a
+        # listen event with a restored_step on their second incarnation.
+        for sid in (0, 1):
+            evs = [e for e in fleet.shards[sid].events
+                   if e.get("event") == "replay_shard_listen"
+                   and e.get("incarnation", 0) >= 1]
+            assert evs, f"shard {sid} second incarnation never announced"

@@ -1,0 +1,188 @@
+"""Attach a whole host's workers to a running learner over TCP — one
+command (PR 8's deferred standalone remote-worker launcher).
+
+The learner reserves remote slots (``actor.remote_workers`` +
+``actor.remote_join_path``) and its pool publishes a JOIN SPEC: one TCP
+endpoint per remote wid (learner host/port, per-run token, attempt, the
+wire-efficiency knobs) plus the full run config and the global actor
+partition, so a remote worker computes exactly the ε-ladder slice the
+fleet reserved for it.  This tool reads that spec and runs the standard
+worker entry (``runtime/process_actors._worker_main``) once per claimed
+slot — the same CPU-only jax children a local pool spawns, just on this
+host, dialing the learner back:
+
+    # on the learner host (the spec can also be scp'd/NFS-shared):
+    python -m ape_x_dqn_tpu --set actor.mode=process \
+        --set actor.transport=tcp --set actor.remote_workers=2 \
+        --set actor.remote_join_path=/shared/host_join.json ...
+    # on the worker host:
+    python tools/host_join.py --join /shared/host_join.json
+
+Experience flows over the CRC-framed transport (torn frames detected,
+never ingested); params arrive on the same connection as delta-or-full
+framed messages; a dropped connection reconnects with jittered backoff.
+This launcher owns the HOST-side incarnation discipline: a child that
+dies is respawned (same attempt — the learner's channel is reused, and
+the launcher guarantees the old writer is dead first, so the
+single-writer contract holds) with its remaining step budget unknown to
+the learner — budget bookkeeping stays chunk-driven learner-side.
+Episode stats and errors print as JSONL lines here; they have no path
+back to the learner by design (the control queue is a process-tree-local
+channel).
+
+``--host`` overrides the spec's advertised learner address for genuinely
+remote hosts (a loopback-bound learner advertises 127.0.0.1, which only
+works for same-host joins).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="host_join", description=__doc__)
+    ap.add_argument("--join", default="host_join.json",
+                    help="join-spec path published by the learner's pool")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="slots to claim (0 = every slot in the spec)")
+    ap.add_argument("--offset", type=int, default=0,
+                    help="first spec slot to claim (multi-host splits)")
+    ap.add_argument("--host", default=None,
+                    help="override the learner address in the spec")
+    ap.add_argument("--nice", type=int, default=None,
+                    help="override actor.worker_nice for this host")
+    ap.add_argument("--wait-s", type=float, default=60.0,
+                    help="how long to wait for the join spec to appear")
+    ap.add_argument("--no-respawn", action="store_true",
+                    help="do not respawn dead children")
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="stop after this many seconds (0 = until signal "
+                    "or every child finishes)")
+    args = ap.parse_args(argv)
+
+    deadline = time.monotonic() + args.wait_s
+    while not os.path.exists(args.join):
+        if time.monotonic() > deadline:
+            print(json.dumps({"event": "host_join_error",
+                              "error": f"no join spec at {args.join}"}))
+            return 1
+        time.sleep(0.25)
+    with open(args.join) as f:
+        doc = json.load(f)
+    specs = doc["specs"][args.offset:]
+    if args.workers:
+        specs = specs[:args.workers]
+    if not specs:
+        print(json.dumps({"event": "host_join_error",
+                          "error": "no remote slots to claim"}))
+        return 1
+    if args.host:
+        for spec in specs:
+            spec["host"] = args.host
+
+    # The worker entry is the pool's own — same jax pinning, same fleet
+    # construction, same transport writer.  Spawn context matches the
+    # pool's (no inherited jax state in children).
+    import multiprocessing as mp
+
+    from ape_x_dqn_tpu.runtime.process_actors import _worker_main
+
+    ctx = mp.get_context("spawn")
+    stop_evt = ctx.Event()
+    queues = {}
+    procs = {}
+    nice = (args.nice if args.nice is not None
+            else int(doc["cfg"]["actor"].get("worker_nice", 0)))
+
+    def spawn(spec) -> None:
+        wid = int(spec["wid"])
+        queues.setdefault(wid, ctx.Queue(maxsize=64))
+        p = ctx.Process(
+            target=_worker_main,
+            args=(wid, doc["cfg"], int(doc["num_workers_total"]),
+                  {"kind": "net"}, spec, queues[wid], stop_evt,
+                  int(doc["budget"]), int(doc["quantum"]),
+                  int(spec.get("attempt", 0)),
+                  int(doc.get("seed_base", 0)), nice, None),
+            daemon=True,
+        )
+        p.start()
+        procs[wid] = p
+        print(json.dumps({"event": "host_join_spawn", "wid": wid,
+                          "pid": p.pid, "learner": f"{spec['host']}:"
+                          f"{spec['port']}"}))
+        sys.stdout.flush()
+
+    for spec in specs:
+        spawn(spec)
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop_evt.set())
+    print(json.dumps({"event": "host_join_up", "workers": len(procs),
+                      "wids": sorted(procs)}))
+    sys.stdout.flush()
+
+    import queue as queue_mod
+
+    done = set()
+    t_end = time.monotonic() + args.duration if args.duration else None
+    episodes = 0
+    while not stop_evt.is_set():
+        if t_end and time.monotonic() > t_end:
+            stop_evt.set()
+            break
+        for wid, q in queues.items():
+            try:
+                while True:
+                    msg = q.get_nowait()
+                    if msg[0] == "done":
+                        done.add(wid)
+                        print(json.dumps({"event": "host_join_done",
+                                          "wid": wid, "steps": msg[2]}))
+                    elif msg[0] == "error":
+                        print(json.dumps({"event": "host_join_worker_error",
+                                          "wid": wid, "error": msg[2]}))
+                    elif msg[0] == "episodes":
+                        episodes += len(msg[2])
+            except queue_mod.Empty:
+                pass
+            except Exception:  # noqa: BLE001 — torn control pickle
+                pass
+        for spec in specs:
+            wid = int(spec["wid"])
+            p = procs.get(wid)
+            if p is not None and not p.is_alive() and wid not in done \
+                    and not args.no_respawn:
+                # Same attempt on purpose: the learner's channel for this
+                # wid admits attempt-N hellos only, and this launcher just
+                # confirmed the previous writer is dead — the reconnect
+                # adopts cleanly (reconnects counted learner-side).
+                p.join(timeout=1.0)
+                print(json.dumps({"event": "host_join_respawn",
+                                  "wid": wid}))
+                sys.stdout.flush()
+                spawn(spec)
+        if done and len(done) == len(procs):
+            break
+        time.sleep(0.25)
+    stop_evt.set()
+    for p in procs.values():
+        p.join(timeout=15.0)
+        if p.is_alive():
+            p.terminate()
+            p.join(timeout=5.0)
+    print(json.dumps({"event": "host_join_exit", "finished": sorted(done),
+                      "episodes": episodes}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -65,7 +65,8 @@ def snapshot_from_jsonl(path: str) -> dict:
     )
     out = {"learner": {k: last[k] for k in learner_keys if k in last}}
     for section in ("workers", "lineage", "xp_transport", "ckpt",
-                    "stage_us", "net", "serving_net", "serving_router"):
+                    "stage_us", "net", "serving_net", "serving_router",
+                    "replay_svc"):
         if section in last:
             out[section] = last[section]
     out["t"] = last.get("t")
@@ -167,6 +168,21 @@ def render(snap: dict) -> str:
             f"codec {xnet.get('codec', 'off')} "
             f"({xnet.get('codec_ms', 0)} ms)  "
             f"torn {xnet.get('torn_frames', 0)}"
+        )
+    rsvc = snap.get("replay_svc")
+    if rsvc:
+        down = rsvc.get("down") or []
+        lines.append(
+            f"-- replay svc  {rsvc.get('shards', 0) - len(down)}"
+            f"/{rsvc.get('shards', 0)} shards up"
+            + (f" (down {down}, {rsvc.get('degraded_age_s', 0)}s)"
+               if down else "")
+            + f"  size {rsvc.get('size', 0)}  "
+            f"s/a/u {rsvc.get('samples', 0)}/{rsvc.get('adds', 0)}"
+            f"/{rsvc.get('updates', 0)}  "
+            f"wb pend {rsvc.get('writeback_pending', 0)} "
+            f"flushed {rsvc.get('writeback_flushed', 0)}  "
+            f"torn {rsvc.get('rpc_torn', 0)}"
         )
     snet = snap.get("serving_net") or (snap.get("serving") or {}).get("net")
     if snet:

@@ -48,7 +48,18 @@
 #         SIGKILLed mid-burst (drained, respawned, full-synced), zero
 #         dropped requests and fresh param_version on both replicas
 #         (tools/serving_net_smoke.py).
-# Gate 10: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
+# Gate 10: replay-service smoke — replay as a service end to end: a
+#         2-shard replay fleet (own processes, own checkpoint chains),
+#         TWO CLI learners attached over framed RPC, a remote worker
+#         host joined via tools/host_join.py, one shard SIGKILLed
+#         mid-run by the seeded kill-shard-at-step drill; both learners
+#         must keep training through the outage (typed degradation,
+#         buffered priority write-backs), the respawned shard must
+#         recover bit-exact-or-typed from its chain (digest-verified
+#         against the frozen chain), write-backs must flush, and no
+#         torn frame may appear on either side
+#         (tools/replay_svc_smoke.py).
+# Gate 11: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
 #         command changes, change it HERE too (they must stay
 #         character-identical modulo this wrapper's cd).
 cd "$(dirname "$0")/.." || exit 1
@@ -61,4 +72,5 @@ timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py > /tmp/_t1_c
 timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/spill_smoke.py > /tmp/_t1_spill.log 2>&1 || { echo "spill smoke FAILED:"; cat /tmp/_t1_spill.log; exit 1; }
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/net_smoke.py > /tmp/_t1_net.log 2>&1 || { echo "net smoke FAILED:"; cat /tmp/_t1_net.log; exit 1; }
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/serving_net_smoke.py > /tmp/_t1_snet.log 2>&1 || { echo "serving-net smoke FAILED:"; cat /tmp/_t1_snet.log; exit 1; }
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/replay_svc_smoke.py > /tmp/_t1_rsvc.log 2>&1 || { echo "replay-svc smoke FAILED:"; cat /tmp/_t1_rsvc.log; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
