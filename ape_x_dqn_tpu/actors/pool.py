@@ -407,12 +407,22 @@ class ActorFleet:
         self,
         num_steps: int,
         param_source=None,
+        selector=None,
     ) -> tuple[List[Chunk], List[EpisodeStat]]:
         """Run ``num_steps`` fleet steps; return emitted chunks + episode
         stats.  The synchronous core — the async runtime wraps this in a
         thread; the deterministic test mode calls it directly.
+
+        ``selector`` is the central-inference seam (actor.inference=
+        central; serving/central.CentralSelector): when given, action
+        selection is ``selector.select(obs, step) -> (actions, q,
+        param_version)`` — the fleet holds NO params, ``param_version``
+        tracks the serving tier's replies, and the q rows feed the
+        priority math exactly as local q values do.  Everything else
+        (history ring, n-step emission, priorities, episode stats) is
+        identical in both modes.
         """
-        if self.params is None:
+        if selector is None and self.params is None:
             if param_source is None or not self.sync_params(param_source):
                 raise RuntimeError(
                     "ActorFleet has no params — call sync_params or pass param_source"
@@ -420,12 +430,21 @@ class ActorFleet:
         chunks: List[Chunk] = []
         stats: List[EpisodeStat] = []
         for _ in range(num_steps):
-            # One transfer for both outputs: each device round trip costs
-            # fixed latency (tunneled platforms: ~100-250 ms), so the fleet
-            # batch size — not the per-actor work — sets the FPS ceiling.
-            actions, q = jax.device_get(self._policy_step(
-                self.params, self._obs, self._epsilons, self._step_count
-            ))
+            if selector is not None:
+                actions, q, version = selector.select(
+                    self._obs, self._step_count
+                )
+                actions = np.asarray(actions)
+                q = np.asarray(q)
+                self.param_version = int(version)
+            else:
+                # One transfer for both outputs: each device round trip
+                # costs fixed latency (tunneled platforms: ~100-250 ms),
+                # so the fleet batch size — not the per-actor work — sets
+                # the FPS ceiling.
+                actions, q = jax.device_get(self._policy_step(
+                    self.params, self._obs, self._epsilons, self._step_count
+                ))
             vs = self.envs.step(actions)
             done = vs.terminated | vs.truncated
             discount = (self.gamma * (1.0 - done)).astype(np.float32)

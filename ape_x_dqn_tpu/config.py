@@ -157,6 +157,57 @@ class ActorConfig:
     # the full run config + the per-run token).  Required non-empty when
     # remote_workers > 0; host_join.py reads it.
     remote_join_path: str = ""
+    # --- central inference (SEED-style; serving/central.py) ---
+    # Where action selection runs.  "local" (default): each worker holds a
+    # param snapshot and runs its own jitted policy_step — the Ape-X
+    # shape, params fanned out to every actor.  "central": workers hold
+    # NO params; each fleet step ships the observation batch as a
+    # CRC-framed inference request to the serving tier's micro-batcher
+    # (direct to a ServingNetServer or through the ServingRouter) and the
+    # reply carries greedy actions + q-rows + param_version.  ε-greedy is
+    # applied WORKER-SIDE on the returned argmax from the same global
+    # ε-ladder slice the worker would use locally (pinned by test), so
+    # the exploration partition is placement-independent either way.
+    inference: str = "local"
+    # Serving endpoint the workers dial.  Port 0 = auto: the trainer
+    # hosts an in-process PolicyServer + ServingNetServer on an ephemeral
+    # port and patches the resolved endpoint into the worker config
+    # before spawn (the self-contained one-process-tree deployment); a
+    # nonzero port names an external ServingNetServer or ServingRouter.
+    inference_host: str = "127.0.0.1"
+    inference_port: int = 0
+    # Per-run serving token (v2 serve hello).  0 = anonymous (the serving
+    # port accepts any client); auto mode generates a fresh token per run
+    # so a stale worker from another run is rejected at the handshake.
+    inference_token: int = 0
+    # Outstanding inference requests each worker pipelines per fleet
+    # step: the fleet's observation batch splits into this many
+    # contiguous row groups, all in flight on one connection at once, so
+    # the central micro-batcher sees real concurrency even from one
+    # worker (more workers multiply it).
+    inference_inflight: int = 4
+    # Obs-payload wire economy (the xpb container from PR 10, applied to
+    # the obs→inference path): "zlib" deflates each request's obs batch
+    # (kept only when smaller; negotiated at the hello), "off" ships raw.
+    # In-request frame dedup rides the same container (identical
+    # obs rows — common under frame-stacking and early-episode resets —
+    # ship once and repeat as refs) when inference_dedup is set.
+    inference_codec: str = "off"
+    inference_dedup: bool = True
+    # Per-select deadline: one fleet step's action selection not answered
+    # within this (across reconnects and whole-request retries) is a
+    # typed InferenceUnavailable — the worker then either falls back
+    # (below) or keeps retrying with the stall counted, never a silent
+    # wedge.
+    inference_timeout_s: float = 30.0
+    # Sustained-outage behavior.  "none" (default): block with a bounded
+    # stall counter until the serving tier answers (paramless actors stay
+    # paramless).  "local": fall back to cached-params local inference —
+    # the worker keeps its param subscription and a compiled policy_step,
+    # serving actions from the last adopted snapshot until the central
+    # path recovers (config-gated precisely because it reintroduces the
+    # param fan-out the central mode exists to remove).
+    inference_fallback: str = "none"
     # Floor between a worker's death and its respawn, enforced by
     # ProcessActorPool.supervise() even when no supervisor policy is
     # attached: a worker whose env crashes deterministically at startup
@@ -311,6 +362,11 @@ class ReplayConfig:
     # RPC payload codec — the wire-efficiency layers carried through:
     # add/sample bodies are F_XPB-encoded (in-window frame dedup + zlib,
     # negotiated at the hello exactly like the experience plane).
+    # "auto": shard-side sample replies compress ONLY while the shard's
+    # reply path observes socket backpressure (blocked sends), so the
+    # priced incompressible worst case (zlib CPU for bytes the link
+    # didn't need — demos/replay_svc.json) stops being the default tax;
+    # client-side bodies ride the same negotiation.
     service_codec: str = "zlib"
     service_dedup: bool = True
     # Per-request deadline: a request not answered within this (across
@@ -625,6 +681,18 @@ class ApexConfig:
              "actor.spawn_stagger_s must be >= 0"),
             (a.respawn_min_interval_s >= 0.0,
              "actor.respawn_min_interval_s must be >= 0"),
+            (a.inference in ("local", "central"),
+             f"unknown actor.inference: {a.inference}"),
+            (0 <= a.inference_port <= 65535,
+             "actor.inference_port must be in [0, 65535]"),
+            (a.inference_inflight >= 1,
+             "actor.inference_inflight must be >= 1"),
+            (a.inference_codec in ("off", "zlib"),
+             f"unknown actor.inference_codec: {a.inference_codec}"),
+            (a.inference_timeout_s > 0.0,
+             "actor.inference_timeout_s must be > 0"),
+            (a.inference_fallback in ("none", "local"),
+             f"unknown actor.inference_fallback: {a.inference_fallback}"),
             (s.param_stale_s >= 0.0,
              "serving.param_stale_s must be >= 0"),
             (0 <= s.listen_port <= 65535,
@@ -690,7 +758,7 @@ class ApexConfig:
             (r.service_mode == "off" or r.service_endpoints,
              "replay.service_mode=attach requires replay.service_endpoints "
              "(the fleet's endpoints file)"),
-            (r.service_codec in ("off", "zlib"),
+            (r.service_codec in ("off", "zlib", "auto"),
              f"unknown replay.service_codec: {r.service_codec}"),
             (r.service_request_timeout_s > 0.0,
              "replay.service_request_timeout_s must be > 0"),

@@ -137,9 +137,16 @@ _DIGEST_REQ = struct.Struct("<B7x")     # with_crc flag
 # count, cursor, size, incarnation, capacity, total_mass, crc
 _DIGEST_REP = struct.Struct("<qqqqqdI4x")
 
-_CODEC_IDS = {"off": CODEC_OFF, "zlib": CODEC_ZLIB}
+# "auto" proposes the zlib capability at the hello (like the experience
+# plane's net_codec=auto); whether a given SAMPLE reply actually
+# compresses is the shard's per-reply decision, gated on observed socket
+# backpressure — see ReplayShardServer._reply_codec.
+_CODEC_IDS = {"off": CODEC_OFF, "zlib": CODEC_ZLIB, "auto": CODEC_ZLIB}
 _RECV_CHUNK = 1 << 16
 _DEFAULT_MAX_FRAME = 64 << 20
+# service_codec=auto: raw sample replies again after this many
+# backpressure-free reply flushes (NetWriter's _AUTO_OFF_FLUSHES twin).
+_AUTO_OFF_REPLIES = 256
 
 
 class ReplayShardUnavailable(RuntimeError):
@@ -297,6 +304,19 @@ class ReplayShardServer:
         self.bytes_in = 0
         self.bytes_out = 0
         self.logical_bytes_in = 0   # decoded add/update record bytes
+        # service_codec=auto control loop: compress sample replies only
+        # while the reply path observes kernel-buffer backpressure
+        # (blocked sends), so the incompressible worst case — zlib CPU
+        # for bytes the link didn't need (the priced 16.8 ms leg in
+        # demos/replay_svc.json) — is paid only when the wire is the
+        # bottleneck.  The hello still negotiates the CAPABILITY; this
+        # gates per-reply use.
+        self.reply_full_waits = 0   # sends that hit a full kernel buffer
+        self.reply_zlib = 0         # sample replies shipped compressed
+        self.reply_raw = 0          # sample replies shipped raw
+        self._auto_on = False
+        self._auto_idle = 0
+        self._auto_fw_mark = 0
         # Shard-owned persistence: the incremental chain under
         # <ckpt_dir>; save() runs on the pump thread at the wall cadence
         # (step = transitions ever added — the shard's own clock).
@@ -616,10 +636,14 @@ class ReplayShardServer:
                 "idx": np.asarray(idx, np.int64),
                 "mass": np.asarray(mass, np.float64),
             },
-            codec=_CODEC_IDS[self._codec_policy]
+            codec=self._reply_codec()
             if conn.codec != CODEC_OFF else CODEC_OFF,
             dedup=True,
         )
+        if rep_body[:1] == b"\x01":
+            self.reply_zlib += 1
+        else:
+            self.reply_raw += 1
         self._reply(conn, req_id, OP_SAMPLE,
                     _SAMPLE_REP.pack(float(total), int(size)) + rep_body)
 
@@ -648,6 +672,27 @@ class ReplayShardServer:
         ))
 
     # -- reply path --------------------------------------------------------
+
+    def _reply_codec(self) -> int:
+        """Effective SAMPLE-reply codec under the shard's policy.  "auto"
+        mirrors NetWriter's control loop: zlib turns on when a reply send
+        blocked since the last check (the wire is the bottleneck — codec
+        CPU now buys throughput) and reverts after _AUTO_OFF_REPLIES
+        backpressure-free replies (a fast link stops paying for bytes it
+        doesn't need)."""
+        if self._codec_policy == "zlib":
+            return CODEC_ZLIB
+        if self._codec_policy != "auto":
+            return CODEC_OFF
+        if self.reply_full_waits > self._auto_fw_mark:
+            self._auto_fw_mark = self.reply_full_waits
+            self._auto_on = True
+            self._auto_idle = 0
+        elif self._auto_on:
+            self._auto_idle += 1
+            if self._auto_idle >= _AUTO_OFF_REPLIES:
+                self._auto_on = False
+        return CODEC_ZLIB if self._auto_on else CODEC_OFF
 
     def _reply(self, conn: _RConn, req_id: int, op: int, body,
                flags: int = 0) -> None:
@@ -678,6 +723,9 @@ class ReplayShardServer:
             try:
                 n = conn.sock.send(memoryview(buf)[conn.out_off:])
             except (BlockingIOError, InterruptedError):
+                # Kernel send buffer full: the reply path is wire-bound —
+                # the signal the auto codec gate compresses on.
+                self.reply_full_waits += 1
                 return
             except OSError:
                 self._retire(conn)
@@ -720,6 +768,11 @@ class ReplayShardServer:
             "bytes_in": bytes_in,
             "bytes_out": bytes_out,
             "logical_bytes_in": self.logical_bytes_in,
+            "codec_policy": self._codec_policy,
+            "reply_full_waits": self.reply_full_waits,
+            "reply_zlib": self.reply_zlib,
+            "reply_raw": self.reply_raw,
+            "auto_codec_on": self._auto_on,
             "size": int(self.replay.size()),
             "total_added": int(self.replay.total_added),
             "saves": self.saves,
@@ -1791,7 +1844,8 @@ def main(argv=None) -> int:
     ap.add_argument("--incarnation", type=int, default=0)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
-    ap.add_argument("--codec", default="zlib", choices=("off", "zlib"))
+    ap.add_argument("--codec", default="zlib",
+                    choices=("off", "zlib", "auto"))
     ap.add_argument("--ckpt-dir", default="")
     ap.add_argument("--save-every-s", type=float, default=2.0)
     ap.add_argument("--base-every", type=int, default=16)

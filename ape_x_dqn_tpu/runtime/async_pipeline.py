@@ -182,8 +182,13 @@ class _ActorWorker:
                  logger: MetricLogger, fps: RateCounter,
                  max_restarts: int = 3, quantum: Optional[int] = None,
                  sink=None, seed_base: int = 0, lineage=None,
-                 trace_sample_rate: float = 0.0):
+                 trace_sample_rate: float = 0.0, selector_factory=None):
         self._comps = comps
+        # Central inference (actor.inference=central): a factory
+        # (fleet, incarnation) -> CentralSelector replaces local action
+        # selection — the fleet never syncs params (unless the selector's
+        # fallback does, on its own).
+        self._selector_factory = selector_factory
         # Lineage (obs/lineage): thread-mode chunks have no wire envelope,
         # so the trace id is stamped HERE, at the sink hand-off — t_act and
         # t_ingest coincide (the flush happened microseconds ago in
@@ -241,17 +246,29 @@ class _ActorWorker:
         steps_done = 0
         while not self._stop.is_set():
             fleet = None
+            selector = None
             try:
                 fleet = self._comps.make_fleet(
                     seed_offset=self._seed_base + self.restarts
                 )
-                fleet.sync_params(self._store)
-                self._run_fleet(fleet, self._comps.cfg.actor.T - steps_done)
+                if self._selector_factory is not None:
+                    selector = self._selector_factory(fleet, self.restarts)
+                else:
+                    fleet.sync_params(self._store)
+                self._run_fleet(fleet, self._comps.cfg.actor.T - steps_done,
+                                selector=selector)
                 self.fleet_steps = steps_done + fleet.step_count
                 # Distinguish "actor.T exhausted" from "told to stop".
                 self.finished = not self._stop.is_set()
                 return  # clean stop
             except Exception as e:
+                if self._stop.is_set():
+                    # A stop raced the central select (typed
+                    # InferenceUnavailable) or teardown: clean exit, not
+                    # a crash — no restart credit consumed.
+                    if fleet is not None:
+                        self.fleet_steps = steps_done + fleet.step_count
+                    return
                 if fleet is not None:
                     steps_done += fleet.step_count
                     self.fleet_steps = steps_done
@@ -263,13 +280,17 @@ class _ActorWorker:
                     return
                 time.sleep(0.1)
 
-    def _run_fleet(self, fleet, max_steps: int):
+    def _run_fleet(self, fleet, max_steps: int, selector=None):
         while not self._stop.is_set() and fleet.step_count < max_steps:
             # Clamp the final quantum so the fleet lands on max_steps
             # exactly — actor.T bounds TOTAL env steps, and an unclamped
             # collect could overshoot by quantum-1 steps per incarnation.
             quantum = min(self._quantum, max_steps - fleet.step_count)
-            chunks, stats = fleet.collect(quantum, param_source=self._store)
+            chunks, stats = fleet.collect(
+                quantum,
+                param_source=self._store if selector is None else None,
+                selector=selector,
+            )
             for chunk in chunks:
                 idx = self._sink(chunk.priorities, chunk.transitions)
                 self.actor_steps += chunk.actor_steps
@@ -562,10 +583,21 @@ class AsyncPipeline:
                 seed_base=self._proc_idx * 7919,  # per-host exploration
                 postmortem_dir=self._postmortem_dir,
             )
-            self.store = pool.store
-            # _params_host: under multi-host the state may already be
-            # placed over the global mesh — publish the local replica.
-            self.store.publish(self._params_host(self.comps.state.params))
+            if pool.store is None:
+                # Central-paramless fleet (actor.inference=central, no
+                # local fallback): workers receive actions, not params —
+                # the plain host store exists only to feed the serving
+                # tier's hot reload (and the param_version metric).
+                self.store = ParamStore(
+                    self._params_host(self.comps.state.params)
+                )
+            else:
+                self.store = pool.store
+                # _params_host: under multi-host the state may already be
+                # placed over the global mesh — publish the local replica.
+                self.store.publish(
+                    self._params_host(self.comps.state.params)
+                )
             self.worker = ProcessActorWorker(
                 pool,
                 sink if sink is not None else (
@@ -597,6 +629,30 @@ class AsyncPipeline:
                 seed_base=self._proc_idx * 7919,
                 lineage=self._lineage,
                 trace_sample_rate=ocfg.trace_sample_rate,
+                selector_factory=(
+                    self._make_central_selector
+                    if self.cfg.actor.inference == "central" else None
+                ),
+            )
+        # --- central inference (actor.inference=central) -------------------
+        # SEED-style paramless actors: action selection lives in the
+        # serving tier's micro-batcher.  Auto mode (inference_port=0)
+        # hosts the PolicyServer + ServingNetServer in THIS process —
+        # the serving fleet and the training fleet are literally the
+        # same process tree — and patches the resolved endpoint + run
+        # token into the worker config before spawn; a nonzero port
+        # names an external ServingNetServer or ServingRouter.
+        self._central_server = None
+        self._central_net = None
+        self._central_selectors: list = []
+        self._central_endpoint = None
+        if self.cfg.actor.inference == "central":
+            self._build_central_serving()
+            self.obs_registry.register_provider(
+                "inference", self._inference_section
+            )
+            self.register_jsonl_section(
+                "inference", self._inference_section
             )
         self.obs_registry.register_provider("learner", self._learner_varz)
         self.obs_registry.register_provider(
@@ -742,6 +798,122 @@ class AsyncPipeline:
                 if self.cfg.learner.checkpoint_every else []
             )
             self._chaos.attach(pool=pool, ckpt_dirs=ckpt_dirs)
+
+    def _build_central_serving(self) -> None:
+        """Resolve the central-inference endpoint: host an in-process
+        serving tier when auto (port 0), else adopt the configured
+        external endpoint (a ServingNetServer or ServingRouter)."""
+        a, s = self.cfg.actor, self.cfg.serving
+        host, port, token = (
+            a.inference_host, int(a.inference_port), int(a.inference_token)
+        )
+        if port == 0:
+            import secrets
+
+            from ape_x_dqn_tpu.serving.net_server import ServingNetServer
+            from ape_x_dqn_tpu.serving.server import PolicyServer
+
+            if token == 0:
+                token = secrets.randbits(63) or 1
+            server = PolicyServer(
+                self.comps.network,
+                params=self._params_host(self.comps.state.params),
+                param_source=self.store,
+                max_batch=s.max_batch,
+                max_wait_ms=s.max_wait_ms,
+                queue_capacity=s.queue_capacity,
+                reload_poll_s=s.reload_poll_s,
+            )
+            server.warmup(self.comps.obs_shape)
+            server.start()
+            net = ServingNetServer(
+                server, host=host, port=0,
+                max_request_bytes=s.max_request_bytes, run_token=token,
+            ).start()
+            self._central_server, self._central_net = server, net
+            port = net.port
+            self.health.register(
+                "central_serving",
+                lambda: time.monotonic() - server.batcher.heartbeat,
+            )
+            self.logger.event(
+                "central_inference_listen", port=port, host=host
+            )
+        self._central_endpoint = (host, port, token)
+        pool = getattr(self.worker, "pool", None)
+        if pool is not None and hasattr(pool, "set_inference_endpoint"):
+            pool.set_inference_endpoint(host, port, token)
+
+    def _make_central_selector(self, fleet, incarnation: int = 0):
+        """Thread-mode selector factory (one fleet per _ActorWorker
+        incarnation): the same client/selector the process workers build
+        from their config, dialing the resolved endpoint in-process."""
+        from ape_x_dqn_tpu.serving.central import (
+            CentralInferenceClient,
+            CentralSelector,
+            InferenceUnavailable,
+        )
+
+        a = self.cfg.actor
+        host, port, token = self._central_endpoint
+        client = CentralInferenceClient(
+            host, port, wid=0, attempt=incarnation, token=token,
+            codec=a.inference_codec, dedup=a.inference_dedup,
+            inflight=a.inference_inflight, seed=self.cfg.seed,
+        )
+        fallback = None
+        if a.inference_fallback == "local":
+            def fallback(obs, step, _fleet=fleet):
+                import jax
+
+                _fleet.sync_params(self.store)
+                if _fleet.params is None:
+                    raise InferenceUnavailable("no param snapshot yet")
+                acts, q = jax.device_get(_fleet._policy_step(
+                    _fleet.params, obs, _fleet._epsilons, step
+                ))
+                return np.asarray(acts), np.asarray(q), _fleet.param_version
+        sel = CentralSelector(
+            client, np.asarray(fleet._epsilons), fleet.envs.num_actions,
+            seed=self.cfg.seed + 77_000 + incarnation,
+            timeout_s=a.inference_timeout_s, fallback=fallback,
+            should_stop=self.stop_event.is_set,
+        )
+        self._central_selectors = [sel]   # latest incarnation wins
+        return sel
+
+    def _inference_section(self) -> dict:
+        """The obs ``inference`` section (docs/METRICS.md "Inference
+        schema"): the fleet-side client aggregate + the serving-side
+        occupancy/freshness the trainer can see."""
+        from ape_x_dqn_tpu.serving.central import aggregate_inference_stats
+
+        pool = getattr(self.worker, "pool", None)
+        if pool is not None and hasattr(pool, "inference_stats"):
+            out = pool.inference_stats()
+        else:
+            out = aggregate_inference_stats(
+                [s.stats(include_hist=True)
+                 for s in self._central_selectors]
+            )
+            out.pop("rtt_state", None)
+        # Freshness: publishes the newest reply version trails the store
+        # by — 0 means actors act on the batcher's current params (the
+        # staleness collapse central inference exists for).
+        v = out.get("param_version", -1)
+        out["version_lag"] = (
+            max(0, self.store.version - v) if v >= 0 else None
+        )
+        occ = None
+        if self._central_server is not None:
+            hist = self._central_server.batcher.batch_hist
+            total = sum(hist.values())
+            if total:
+                occ = round(
+                    sum(k * c for k, c in hist.items()) / total, 2
+                )
+        out["batch_occupancy_mean"] = occ
+        return out
 
     def _degrade_pipeline(self) -> None:
         """Watchdog degrade action: strict dispatch from now on (and a
@@ -1381,6 +1553,25 @@ class AsyncPipeline:
         self.recorder.dump(self._postmortem_dir, "fault")
 
     def _close_obs(self) -> None:
+        # Central serving teardown first: the workers are already joined
+        # by every caller's finally ordering, so no select is in flight.
+        if self._central_net is not None:
+            try:
+                self._central_net.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        if self._central_server is not None:
+            try:
+                self._central_server.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            # Reference kept: the final emit still reads batch occupancy
+            # (closing is idempotent; counters survive close).
+        for sel in self._central_selectors:
+            try:
+                sel.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         if self._chaos is not None:
             try:
                 self._chaos.stop()

@@ -114,6 +114,8 @@ _CODEC_IDS = {"off": CODEC_OFF, "zlib": CODEC_ZLIB, "auto": CODEC_ZLIB}
 F_SREQ = 16        # client → server: one observation to act on
 F_SREP = 17        # server → client: greedy action + evidence
 F_SERR = 18        # server → client: typed refusal (shed / closed / bad)
+F_IREQ = 19        # fleet worker → server: batched inference request
+F_IREP = 20        # server → worker: batched greedy actions + q rows
 
 # Replay-service RPC kinds (replay/service.py) — the replay plane is the
 # third protocol on this frame discipline: sample/add/update-priorities/
@@ -142,12 +144,18 @@ _SEND_SLICE = 1 << 18
 _AUTO_OFF_FLUSHES = 256   # net_codec=auto: raw again after this many
 #                           backpressure-free flushes
 
-# Serving hello: clients are anonymous (no run token — the serving port is
-# a public-ish front door, not the fleet's private experience plane), but
-# the magic/version still reject port confusion before any framing state.
+# Serving hello: v1 clients are anonymous (no run token — the serving
+# port is a public-ish front door, not the fleet's private experience
+# plane), but the magic/version still reject port confusion before any
+# framing state.  v2 adds the fleet-internal extension (central
+# inference, serving/central.py): worker id + spawn attempt (per-source
+# stats), the pool's per-run token (a server started with one rejects
+# mismatches at the handshake), and the negotiated obs-payload codec.
 SERVE_MAGIC = b"APXQ"
 SERVE_VERSION = 1
+SERVE_VERSION_EXT = 2
 SERVE_HELLO = struct.Struct("<4sI")
+SERVE_HELLO_EXT = struct.Struct("<qqqB7x")   # wid, attempt, token, codec
 # Request: u64 req_id | u8 ndim | u8 dtype (0=uint8) | 6x pad | u32 dims…
 _SREQ_HEAD = struct.Struct("<QBB6x")
 _SREQ_DIM = struct.Struct("<I")
@@ -192,8 +200,16 @@ def serve_hello_bytes() -> bytes:
     return SERVE_HELLO.pack(SERVE_MAGIC, SERVE_VERSION)
 
 
+def serve_hello_ext_bytes(wid: int, attempt: int, token: int,
+                          codec: int = CODEC_OFF) -> bytes:
+    """The v2 fleet-internal hello (central inference): the v1 header
+    with the extension struct right behind it."""
+    return SERVE_HELLO.pack(SERVE_MAGIC, SERVE_VERSION_EXT) + \
+        SERVE_HELLO_EXT.pack(int(wid), int(attempt), int(token), int(codec))
+
+
 def parse_serve_hello(buf: bytes) -> bool:
-    """True iff ``buf`` is a valid serving-protocol hello."""
+    """True iff ``buf`` is a valid v1 serving-protocol hello."""
     if len(buf) != SERVE_HELLO.size:
         return False
     try:
@@ -201,6 +217,21 @@ def parse_serve_hello(buf: bytes) -> bool:
     except struct.error:
         return False
     return magic == SERVE_MAGIC and version == SERVE_VERSION
+
+
+def parse_serve_hello_ext(buf: bytes) -> Optional[dict]:
+    """Decode a v2 hello extension (the bytes AFTER the 8-byte header);
+    None on malformation."""
+    if len(buf) != SERVE_HELLO_EXT.size:
+        return None
+    try:
+        wid, attempt, token, codec = SERVE_HELLO_EXT.unpack(buf)
+    except struct.error:
+        return None
+    if codec not in (CODEC_OFF, CODEC_ZLIB):
+        return None
+    return {"wid": int(wid), "attempt": int(attempt),
+            "token": int(token), "codec": int(codec)}
 
 
 def encode_request(req_id: int, obs) -> bytes:
@@ -277,6 +308,115 @@ def decode_error(payload: bytes):
     return int(req_id), int(code), payload[_SERR_HEAD.size:].decode(
         errors="replace"
     )
+
+
+# Batched inference (central actors, serving/central.py): one F_IREQ
+# carries a whole observation-row group; the body is the F_XPB container
+# (per-row encode_request records + in-request frame dedup + negotiated
+# codec), so the obs→inference path inherits PR 10's wire economy and
+# its adversarial decode contract unchanged.
+_IREQ_HEAD = struct.Struct("<QI4x")    # req_id, n_rows
+_IREP_HEAD = struct.Struct("<QIIq")    # req_id, n_rows, n_actions, version
+_MAX_IREQ_ROWS = 1 << 16
+
+
+def _obs_record_spans(rec: bytes, ndim: int, shape) -> List[Tuple[int, int]]:
+    """Dedup-candidate spans of one encode_request record: the leading-
+    axis planes of the obs body (frame-stacked obs repeat stack−1 planes
+    between rows that coincide) or the whole body when it doesn't carve."""
+    off = _SREQ_HEAD.size + ndim * _SREQ_DIM.size
+    body = len(rec) - off
+    if body < _MIN_DEDUP_FRAME:
+        return []
+    rows = int(shape[0]) if ndim >= 2 else 1
+    if rows > 0 and body % rows == 0 and body // rows >= _MIN_DEDUP_FRAME:
+        fb = body // rows
+        return [(off + r * fb, fb) for r in range(rows)]
+    return [(off, body)]
+
+
+def encode_inference_request(req_id: int, obs_batch, codec: int = CODEC_OFF,
+                             dedup: bool = True):
+    """(payload, stats) for one F_IREQ frame: head + xpb body of per-row
+    ``encode_request`` records (row index in each record's id slot)."""
+    import numpy as np
+
+    arr = np.ascontiguousarray(obs_batch, dtype=np.uint8)
+    if arr.ndim < 2:
+        raise ValueError("inference request needs a [rows, ...] obs batch")
+    n = arr.shape[0]
+    if not 0 < n <= _MAX_IREQ_ROWS:
+        raise ValueError(f"absurd inference row count {n}")
+    records = [encode_request(i, arr[i]) for i in range(n)]
+    spans = [
+        _obs_record_spans(r, arr.ndim - 1, arr.shape[1:]) for r in records
+    ] if dedup else None
+    body, st = encode_xpb_payload(records, codec=codec, dedup=dedup,
+                                  spans=spans)
+    return _IREQ_HEAD.pack(int(req_id), n) + body, st
+
+
+def decode_inference_request(payload, allow_zlib: bool = True,
+                             max_bytes: int = _MAX_FRAME):
+    """(req_id, [uint8 obs rows]) from one verified F_IREQ payload.
+    Raises ValueError on any malformation — the frame crc already
+    verified these bytes arrived intact, so the caller replies TYPED
+    (E_BAD_REQUEST), mirroring the single-request path."""
+    if len(payload) < _IREQ_HEAD.size:
+        raise ValueError("inference request shorter than its header")
+    req_id, n = _IREQ_HEAD.unpack_from(payload, 0)
+    if not 0 < n <= _MAX_IREQ_ROWS:
+        raise ValueError(f"absurd inference row count {n}")
+    recs = decode_xpb_payload(
+        memoryview(payload)[_IREQ_HEAD.size:], allow_zlib=allow_zlib,
+        max_bytes=max_bytes,
+    )
+    if len(recs) != n:
+        raise ValueError(
+            f"inference request body has {len(recs)} rows, head says {n}"
+        )
+    rows = []
+    for i, rec in enumerate(recs):
+        rid, obs = decode_request(bytes(rec))
+        if rid != i:
+            raise ValueError(f"inference row {i} carries id {rid}")
+        rows.append(obs)
+    return int(req_id), rows
+
+
+def encode_inference_reply(req_id: int, actions, param_version: int,
+                           q_values) -> bytes:
+    """One F_IREP payload: greedy actions + per-row q evidence + the
+    version floor of the params that produced them (ε stays worker-side
+    — the ladder partition is the fleet's, not the server's)."""
+    import numpy as np
+
+    a = np.ascontiguousarray(actions, dtype=np.int32).reshape(-1)
+    q = np.ascontiguousarray(q_values, dtype=np.float32)
+    q = q.reshape(a.size, -1)
+    return _IREP_HEAD.pack(int(req_id), a.size, q.shape[1],
+                           int(param_version)) + a.tobytes() + q.tobytes()
+
+
+def decode_inference_reply(payload):
+    """(req_id, int32 actions [N], param_version, float32 q [N, A]).
+    Raises ValueError on a body that disagrees with its head."""
+    import numpy as np
+
+    if len(payload) < _IREP_HEAD.size:
+        raise ValueError("inference reply shorter than its header")
+    req_id, n, na, version = _IREP_HEAD.unpack_from(payload, 0)
+    if not 0 < n <= _MAX_IREQ_ROWS or na > 1 << 20:
+        raise ValueError("absurd inference reply geometry")
+    off = _IREP_HEAD.size
+    need = off + 4 * n + 4 * n * na
+    if len(payload) != need:
+        raise ValueError(
+            f"inference reply {len(payload)} B != expected {need} B"
+        )
+    actions = np.frombuffer(payload, np.int32, n, off).copy()
+    q = np.frombuffer(payload, np.float32, n * na, off + 4 * n)
+    return int(req_id), actions, int(version), q.reshape(n, na).copy()
 
 
 class FrameParser:
@@ -521,23 +661,33 @@ def _frame_spans(payload) -> List[Tuple[int, int]]:
         return []
 
 
-def encode_batch(records: Sequence[bytes], dedup: bool = True):
+def encode_batch(records: Sequence[bytes], dedup: bool = True,
+                 spans: Optional[Sequence] = None):
     """(body, stats) for one F_XPB batch.  With ``dedup``, observation
     frames repeated within the batch (n-step overlap makes obs[i+n] ==
     next_obs[i] inside one dense chunk) ship once; repeats become refs
     into the reconstructed stream.  Window lookups key the dict by the
     frame BYTES (one slice copy + one siphash per frame — measured
     cheaper than any crc-bucket scheme on this interpreter, and exact by
-    construction: a ref is only ever emitted for full byte equality)."""
+    construction: a ref is only ever emitted for full byte equality).
+
+    ``spans`` (optional, one ``[(offset, nbytes), ...]`` list per record)
+    overrides the APXT-walking candidate finder for records that are not
+    experience chunks — the inference plane hands its own obs-plane
+    spans.  Decode is unchanged either way: the container is
+    span-agnostic (literals + backward refs)."""
     parts: List = [_BU32.pack(len(records))]
     parts += [_BU32.pack(len(r)) for r in records]
     seen: Dict[bytes, int] = {}   # frame bytes -> offset in the stream
     base = 0
     hits = saved = 0
-    for rec in records:
+    for ri, rec in enumerate(records):
         mrec = memoryview(rec)
         lit = 0
-        for off, fb in (_frame_spans(rec) if dedup else ()):
+        rec_spans = () if not dedup else (
+            spans[ri] if spans is not None else _frame_spans(rec)
+        )
+        for off, fb in rec_spans:
             prev = seen.setdefault(rec[off:off + fb], base + off)
             if prev == base + off:
                 continue                 # first sighting: ships literal
@@ -624,11 +774,12 @@ def decode_batch(body) -> List:
 
 
 def encode_xpb_payload(records: Sequence[bytes], codec: int = CODEC_OFF,
-                       dedup: bool = True, level: int = 1):
+                       dedup: bool = True, level: int = 1,
+                       spans: Optional[Sequence] = None):
     """(payload, stats) — the framed F_XPB payload (codec byte + body).
     zlib only sticks when it actually shrinks the body (a batch of
     incompressible frames ships raw under the same codec negotiation)."""
-    body, st = encode_batch(records, dedup=dedup)
+    body, st = encode_batch(records, dedup=dedup, spans=spans)
     used = CODEC_OFF
     if codec == CODEC_ZLIB:
         comp = zlib.compress(body, level)

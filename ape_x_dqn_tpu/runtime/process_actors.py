@@ -338,6 +338,7 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
     buf = None
     ring = None
     sblock = None
+    selector = None
     try:
         from ape_x_dqn_tpu.actors import ActorFleet
         from ape_x_dqn_tpu.envs import make_env
@@ -390,13 +391,18 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
             emit_dedup_groups=_dedup_groups(cfg),
         )
         ring = connect_channel(xp_spec)
+        central = cfg.actor.inference == "central"
         if param_spec["kind"] == "shm":
             buf = SharedParamBuffer(param_spec["capacity"],
                                     name=param_spec["name"], create=False)
             source = SharedBufferParamSource(buf, template)
-        else:
+        elif param_spec["kind"] == "net":
             # tcp: params ride the experience connection in reverse.
             source = NetParamSource(ring, template)
+        else:
+            # "none": central-paramless — the learner fans out NO params
+            # to this worker; action selection is the serving tier's.
+            source = None
         # Observability: the incarnation's shm stats block (parent-created;
         # this worker is the single writer) + a flight recorder mirrored
         # into its event ring.  Metrics must never kill a worker — any
@@ -433,22 +439,81 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
         episodes_total = 0
         collect_s = 0.0
         write_s = 0.0
-        # Wait for the learner's first publication (the reference's
-        # construct-learner-first ordering constraint, main.py:44).
-        deadline = time.monotonic() + 60.0
-        while not fleet.sync_params(source):
-            if stop_evt.is_set() or time.monotonic() > deadline:
-                ctl_queue.put(("done", worker_id, 0))
-                return
-            time.sleep(0.01)
+        # Central inference (actor.inference=central): action selection
+        # moves to the serving tier — build the pipelined client +
+        # selector from the config's endpoint (the pool patches the
+        # resolved auto endpoint into the cfg before spawn).  The worker
+        # holds params only when the local fallback is configured.
+        if central:
+            from ape_x_dqn_tpu.serving.central import (
+                CentralInferenceClient,
+                CentralSelector,
+                InferenceUnavailable,
+            )
+
+            client = CentralInferenceClient(
+                cfg.actor.inference_host, cfg.actor.inference_port,
+                wid=worker_id, attempt=attempt,
+                token=cfg.actor.inference_token,
+                codec=cfg.actor.inference_codec,
+                dedup=cfg.actor.inference_dedup,
+                inflight=cfg.actor.inference_inflight,
+                seed=cfg.seed + worker_id,
+            )
+            fallback_fn = None
+            if cfg.actor.inference_fallback == "local" and source is not None:
+                def fallback_fn(obs, step, _fleet=fleet, _source=source):
+                    # Cached-params local inference: opportunistic sync
+                    # (keeps the last adopted snapshot on a quiet store),
+                    # then the fleet's own jitted ε-greedy policy step —
+                    # literally the local mode, per outage step.
+                    _fleet.sync_params(_source)
+                    if _fleet.params is None:
+                        raise InferenceUnavailable(
+                            "fallback configured but no param snapshot "
+                            "adopted yet"
+                        )
+                    a, qv = _jax.device_get(_fleet._policy_step(
+                        _fleet.params, obs, _fleet._epsilons, step
+                    ))
+                    return np.asarray(a), np.asarray(qv), \
+                        _fleet.param_version
+            selector = CentralSelector(
+                client, np.asarray(fleet._epsilons),
+                fleet.envs.num_actions,
+                seed=cfg.seed + 77_000 + worker_id + 100_000 * attempt,
+                timeout_s=cfg.actor.inference_timeout_s,
+                fallback=fallback_fn,
+                should_stop=stop_evt.is_set,
+            )
+        if selector is None or cfg.actor.inference_fallback == "local":
+            # Wait for the learner's first publication (the reference's
+            # construct-learner-first ordering constraint, main.py:44).
+            # Central-paramless workers skip it: their first action needs
+            # a serving reply, not a snapshot.
+            if source is not None:
+                deadline = time.monotonic() + 60.0
+                while not fleet.sync_params(source):
+                    if selector is not None:
+                        break  # fallback mode: don't gate on the store
+                    if stop_evt.is_set() or time.monotonic() > deadline:
+                        ctl_queue.put(("done", worker_id, 0))
+                        return
+                    time.sleep(0.01)
         while not stop_evt.is_set() and fleet.step_count < steps_budget:
             # Clamp the final quantum: the budget bounds TOTAL fleet steps
             # across incarnations, so the last collect must land exactly.
             t0 = time.monotonic()
-            chunks, ep_stats = fleet.collect(
-                min(quantum, steps_budget - fleet.step_count),
-                param_source=source,
-            )
+            try:
+                chunks, ep_stats = fleet.collect(
+                    min(quantum, steps_budget - fleet.step_count),
+                    param_source=source if selector is None else None,
+                    selector=selector,
+                )
+            except Exception:
+                if selector is not None and stop_evt.is_set():
+                    break  # stop raced a central select: clean exit
+                raise
             collect_s += time.monotonic() - t0
             t0 = time.monotonic()
             for c in chunks:
@@ -519,12 +584,32 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                     episodes=episodes_total, collect_s=collect_s,
                     write_s=write_s,
                 )
+            if selector is not None:
+                # Central-inference client accounting rides the control
+                # queue at the quantum cadence (low volume: one dict) —
+                # the pool folds it into the obs `inference` section.
+                try:
+                    ctl_queue.put_nowait((
+                        "inference", worker_id,
+                        selector.stats(include_hist=True),
+                    ))
+                except Exception:  # noqa: BLE001 — stats must not block
+                    pass
             # Arena hygiene each quantum: the obs-batch allocation stream
             # otherwise grows worker RSS ~0.65 MB/s forever (utils/memory
             # docstring — measured in the round-5 flagship soak).
             trim_malloc()
         recorder.record("done", steps=fleet.step_count,
                         stopped=stop_evt.is_set())
+        if selector is not None:
+            try:
+                ctl_queue.put_nowait((
+                    "inference", worker_id,
+                    selector.stats(include_hist=True),
+                ))
+            except Exception:  # noqa: BLE001 — final stats best-effort
+                pass
+            selector.close()
         ctl_queue.put(("done", worker_id, fleet.step_count))
     except Exception as e:  # noqa: BLE001 — report, don't hang the join
         if sblock is not None:
@@ -540,6 +625,15 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
         except Exception:
             pass
     finally:
+        if selector is not None:
+            # Close the serving connection on EVERY exit path (a socket
+            # abandoned to process teardown can die mid-frame and count
+            # torn server-side for nothing).  Idempotent with the
+            # done-path close.
+            try:
+                selector.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         if buf is not None:
             buf.close()
         if ring is not None:
@@ -598,7 +692,22 @@ class ProcessActorPool:
         self._transport = make_transport(
             cfg, self.total_workers, self._ring_bytes, self._drain_budget
         )
-        if self._transport.kind == "tcp":
+        # Central inference (actor.inference=central): workers select
+        # actions against the serving tier.  Without the local fallback
+        # they are PARAMLESS — no seqlock buffer, no per-connection param
+        # fan-out, store=None (the runtime substitutes a plain host
+        # ParamStore for the serving tier's reload source); with
+        # inference_fallback=local the normal param channel stays up so
+        # outage steps can serve from the cached snapshot.
+        self._central = cfg.actor.inference == "central"
+        self._paramless = (
+            self._central and cfg.actor.inference_fallback != "local"
+        )
+        self.inference_by_worker: dict = {}
+        if self._paramless:
+            self.buffer = None
+            self.store = None
+        elif self._transport.kind == "tcp":
             self.buffer = None
             self.store = NetParamStore(self._transport)
         else:
@@ -678,11 +787,13 @@ class ProcessActorPool:
         self._queues[wid] = self._ctx.Queue(maxsize=self._queue_size)
         self._rings[wid] = self._transport.make_channel(wid, attempt)
         xp_spec = self._transport.endpoint(self._rings[wid], wid, attempt)
-        param_spec = (
-            {"kind": "shm", "name": self.buffer.name,
-             "capacity": self.buffer.capacity}
-            if self.buffer is not None else {"kind": "net"}
-        )
+        if self.buffer is not None:
+            param_spec = {"kind": "shm", "name": self.buffer.name,
+                          "capacity": self.buffer.capacity}
+        elif self.store is not None:
+            param_spec = {"kind": "net"}
+        else:
+            param_spec = {"kind": "none"}   # central-paramless worker
         self._stats_prev.pop(wid, None)  # fresh incarnation: rate resets
         try:
             self._stats_blocks[wid] = WorkerStatsBlock(
@@ -790,18 +901,21 @@ class ProcessActorPool:
             n_fds = len(_os.listdir("/proc/self/fd"))
         except OSError:
             n_fds = -1
-        shm_mode = self.buffer is not None
+        shm_mode = self._transport.kind == "shm"
         return {
             "transport": self._transport.kind,
             "shm_segments": (
-                (1 + len(self._rings) if shm_mode else 0)
+                ((1 if self.buffer is not None else 0) + len(self._rings)
+                 if shm_mode else 0)
                 + len(self._stats_blocks)
             ),
             "ring_bytes_each": self._ring_bytes if shm_mode else 0,
             "ring_bytes_total": (
                 self._ring_bytes * len(self._rings) if shm_mode else 0
             ),
-            "param_buffer_bytes": self.buffer.capacity if shm_mode else 0,
+            "param_buffer_bytes": (
+                self.buffer.capacity if self.buffer is not None else 0
+            ),
             "process_fds": n_fds,
         }
 
@@ -867,8 +981,10 @@ class ProcessActorPool:
         # rings cannot fit /dev/shm (256 workers × ring_bytes is real
         # money).  tcp mode allocates no rings — experience bytes live in
         # kernel socket buffers — so only the shm backend gates here.
-        if self.buffer is not None:
-            need = self.num_workers * self._ring_bytes + self.buffer.capacity
+        if self._transport.kind == "shm":
+            need = self.num_workers * self._ring_bytes + (
+                self.buffer.capacity if self.buffer is not None else 0
+            )
             try:
                 st = _os.statvfs("/dev/shm")
                 free = st.f_bavail * st.f_frsize
@@ -1019,7 +1135,31 @@ class ProcessActorPool:
             self._salvage_incarnation(wid)
 
     def publish(self, params) -> int:
+        if self.store is None:
+            return -1    # central-paramless fleet: nothing to fan out
         return self.store.publish(params)
+
+    def set_inference_endpoint(self, host: str, port: int,
+                               token: int) -> None:
+        """Patch the resolved central-inference endpoint into the worker
+        config BEFORE spawn (auto mode binds an ephemeral port after the
+        config was frozen).  Also lands in the remote join spec, so
+        host_join workers dial the same endpoint."""
+        a = self._cfg_dict["actor"]
+        a["inference_host"] = str(host)
+        a["inference_port"] = int(port)
+        a["inference_token"] = int(token)
+
+    def inference_stats(self) -> dict:
+        """Fleet-wide central-inference accounting (the obs ``inference``
+        section's client half): per-worker counter sums + merged
+        round-trip percentiles from the shipped histogram states."""
+        from ape_x_dqn_tpu.serving.central import aggregate_inference_stats
+
+        return aggregate_inference_stats(
+            self.inference_by_worker.values(),
+            mode="central" if self._central else "local",
+        )
 
     @property
     def finished(self) -> bool:
@@ -1127,6 +1267,10 @@ class ProcessActorPool:
         kind = msg[0]
         if kind == "episodes":
             self.episodes.extend(msg[2])
+        elif kind == "inference":
+            # Latest-wins per worker: each snapshot is cumulative for the
+            # incarnation, so the newest one subsumes the rest.
+            self.inference_by_worker[msg[1]] = msg[2]
         elif kind == "done":
             self.finished_workers.add(msg[1])
             # Cumulative fleet steps across incarnations (each "done"

@@ -76,6 +76,12 @@ def build_argparser() -> argparse.ArgumentParser:
         "router (requires --checkpoint; 0 = serving.replicas default)",
     )
     p.add_argument(
+        "--run-token", type=int, default=0, metavar="TOKEN",
+        help="fleet-internal serving token: v2 hellos (central-inference "
+        "workers) must carry it or are rejected at the handshake; 0 "
+        "accepts any hello (anonymous front door)",
+    )
+    p.add_argument(
         "--params-file", default=None,
         help="JSON config (native or reference format) — must match the "
         "checkpoint's network/env for --checkpoint",
@@ -324,6 +330,7 @@ def main(argv=None) -> int:
         net_srv = ServingNetServer(
             server, host=host, port=port,
             max_request_bytes=s.max_request_bytes,
+            run_token=args.run_token,
         ).start()
         server.attach_transport(net_srv.stats)
         logger.event("serving_listen", port=net_srv.port, host=host,

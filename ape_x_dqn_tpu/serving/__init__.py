@@ -29,6 +29,9 @@ Public surface:
   * ParamSources: :class:`CheckpointParamSource`,
     :class:`SocketParamSource`, :class:`ParamTailSource`
     (+ :class:`ParamTailWriter`);
+  * central inference (SEED-style paramless actors):
+    :class:`CentralInferenceClient` / :class:`CentralSelector`
+    (+ typed :class:`InferenceUnavailable`) — serving/central.py;
   * typed admission errors: :class:`ServerOverloaded`, :class:`ServerClosed`.
 """
 
@@ -40,6 +43,11 @@ from ape_x_dqn_tpu.serving.batcher import (
     ServingError,
     bucket_for,
     bucket_sizes,
+)
+from ape_x_dqn_tpu.serving.central import (
+    CentralInferenceClient,
+    CentralSelector,
+    InferenceUnavailable,
 )
 from ape_x_dqn_tpu.serving.net_server import ServingClient, ServingNetServer
 from ape_x_dqn_tpu.serving.router import (
@@ -57,7 +65,10 @@ from ape_x_dqn_tpu.serving.sources import (
 )
 
 __all__ = [
+    "CentralInferenceClient",
+    "CentralSelector",
     "CheckpointParamSource",
+    "InferenceUnavailable",
     "MicroBatcher",
     "ParamTailSource",
     "ParamTailWriter",

@@ -46,23 +46,33 @@ import time
 from typing import Optional
 
 from ape_x_dqn_tpu.runtime.net import (
+    CODEC_OFF,
     E_BAD_REQUEST,
     E_CLOSED,
     E_INTERNAL,
     E_OVERLOADED,
+    F_IREP,
+    F_IREQ,
     F_SERR,
     F_SREP,
     F_SREQ,
+    SERVE_HELLO,
+    SERVE_HELLO_EXT,
+    SERVE_MAGIC,
+    SERVE_VERSION_EXT,
     Backoff,
     FrameParser,
     decode_error,
+    decode_inference_request,
     decode_reply,
     decode_request,
     encode_error,
+    encode_inference_reply,
     encode_reply,
     encode_request,
     frame_bytes,
     parse_serve_hello,
+    parse_serve_hello_ext,
     serve_hello_bytes,
 )
 from ape_x_dqn_tpu.serving.batcher import (
@@ -81,13 +91,18 @@ class _NetConn:
     """One client connection's state, owned by the pump thread (outbox
     appends come from batcher callbacks under the server lock)."""
 
-    __slots__ = ("sock", "parser", "hello", "outbox", "out_off", "out_seq",
+    __slots__ = ("sock", "parser", "hello", "hello_need", "hello_done",
+                 "wid", "codec", "outbox", "out_off", "out_seq",
                  "bytes_in", "bytes_out", "inflight")
 
     def __init__(self, sock: socket.socket, max_frame: int):
         self.sock = sock
         self.parser = FrameParser(max_frame=max_frame)
         self.hello = bytearray()          # hello bytes gathered so far
+        self.hello_need = _HELLO_SIZE     # grows for a v2 hello
+        self.hello_done = False
+        self.wid: Optional[int] = None    # v2 hellos: the fleet worker id
+        self.codec = CODEC_OFF            # negotiated obs-payload codec
         self.outbox: collections.deque = collections.deque()
         self.out_off = 0                  # send offset into outbox[0]
         self.out_seq = 0
@@ -107,9 +122,15 @@ class ServingNetServer:
 
     def __init__(self, server, host: str = "127.0.0.1", port: int = 0, *,
                  max_request_bytes: int = 8 << 20,
-                 name: str = "serving-net"):
+                 run_token: int = 0, name: str = "serving-net"):
         self._server = server
         self._max_frame = int(max_request_bytes)
+        # Fleet-internal hello discipline (central inference): a nonzero
+        # run_token makes every v2 hello prove it belongs to THIS run —
+        # a stale worker from another run (or a guessing client) is
+        # rejected before any framing state.  v1 anonymous hellos stay
+        # accepted either way: the single-request front door is public.
+        self._run_token = int(run_token)
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((host, int(port)))
@@ -134,7 +155,15 @@ class ServingNetServer:
         self.errors = 0          # bad requests + batch exceptions replied
         self.torn_frames = 0
         self.bad_hellos = 0
+        self.token_rejects = 0   # v2 hellos with the wrong run token
         self.orphaned = 0        # replies whose connection was already gone
+        # Fleet-internal inference traffic (F_IREQ/F_IREP): batched
+        # requests and the rows they carried, plus per-source accounting
+        # keyed by the hello's worker id (the obs `sources` sub-dict).
+        self.inference_requests = 0
+        self.inference_rows = 0
+        self.inference_replies = 0
+        self._sources: dict = {}
         # Retired-connection byte history (a reconnecting client must not
         # take its traffic with it — the NetTransport._base discipline).
         self._bytes_in_closed = 0
@@ -253,20 +282,55 @@ class ServingNetServer:
                 self._retire(conn)
                 return
             conn.bytes_in += len(data)
-            if len(conn.hello) < _HELLO_SIZE:
-                need = _HELLO_SIZE - len(conn.hello)
+            while not conn.hello_done and data:
+                need = conn.hello_need - len(conn.hello)
                 conn.hello += data[:need]
                 data = data[need:]
-                if len(conn.hello) == _HELLO_SIZE and not parse_serve_hello(
-                    bytes(conn.hello)
-                ):
-                    self.bad_hellos += 1
-                    self._retire(conn)
+                if len(conn.hello) < conn.hello_need:
+                    break
+                if not self._admit_hello(conn):
                     return
-                if not data:
-                    continue
-            conn.parser.feed(data)
-        self._drain_frames(conn)
+            if not conn.hello_done:
+                continue
+            if data:
+                conn.parser.feed(data)
+        if conn.hello_done:
+            self._drain_frames(conn)
+
+    def _admit_hello(self, conn: _NetConn) -> bool:
+        """Validate the gathered hello bytes (v1 anonymous or the v2
+        fleet extension).  A v2 version word promises the extension
+        struct right behind it — grow the want and keep gathering.
+        False = rejected and retired (nothing framed yet)."""
+        buf = bytes(conn.hello)
+        if len(buf) == _HELLO_SIZE:
+            if parse_serve_hello(buf):
+                conn.hello_done = True
+                return True
+            try:
+                magic, version = SERVE_HELLO.unpack(buf)
+            except Exception:  # noqa: BLE001 — malformed header
+                magic, version = b"", -1
+            if magic == SERVE_MAGIC and version == SERVE_VERSION_EXT:
+                conn.hello_need = _HELLO_SIZE + SERVE_HELLO_EXT.size
+                return True
+            self.bad_hellos += 1
+            self._retire(conn)
+            return False
+        ext = parse_serve_hello_ext(buf[_HELLO_SIZE:])
+        if ext is None:
+            self.bad_hellos += 1
+            self._retire(conn)
+            return False
+        if self._run_token and ext["token"] != self._run_token:
+            self.token_rejects += 1
+            self.bad_hellos += 1
+            self._retire(conn)
+            return False
+        conn.wid = ext["wid"]
+        conn.codec = ext["codec"]
+        conn.hello_done = True
+        return True
 
     def _drain_frames(self, conn: _NetConn) -> None:
         while True:
@@ -276,12 +340,15 @@ class ServingNetServer:
                     self._retire(conn, torn=True)
                 return
             kind, payload = got
-            if kind != F_SREQ:
+            if kind == F_SREQ:
+                self._handle_request(conn, payload)
+            elif kind == F_IREQ:
+                self._handle_inference(conn, payload)
+            else:
                 # Protocol violation (reply kinds only flow server→client):
                 # stream corruption, connection-level recovery.
                 self._retire(conn, torn=True)
                 return
-            self._handle_request(conn, payload)
 
     def _handle_request(self, conn: _NetConn, payload: bytes) -> None:
         t0 = time.monotonic()
@@ -335,6 +402,113 @@ class ServingNetServer:
             self.replies += 1
             self.latency.record(time.monotonic() - t0)
 
+    # -- batched fleet inference (F_IREQ/F_IREP) ---------------------------
+
+    def _source_count(self, wid, rows: int = 0, replies: int = 0) -> None:
+        if wid is None:
+            return
+        with self._lock:
+            src = self._sources.setdefault(
+                str(wid), {"requests": 0, "rows": 0, "replies": 0}
+            )
+            if rows:
+                src["requests"] += 1
+                src["rows"] += rows
+            if replies:
+                src["replies"] += replies
+
+    def _handle_inference(self, conn: _NetConn, payload: bytes) -> None:
+        """One batched request: every row rides the micro-batcher as its
+        own submit (so rows pad/batch with everything else in flight —
+        the whole point of central inference), and the reply goes out
+        when the LAST row's future lands.  ε is never applied here: the
+        reply carries greedy actions + q rows, the worker's ladder slice
+        stays worker-side (pinned by test)."""
+        t0 = time.monotonic()
+        try:
+            req_id, rows = decode_inference_request(
+                payload, allow_zlib=conn.codec != CODEC_OFF,
+                max_bytes=self._max_frame,
+            )
+        except ValueError as e:
+            # Well-framed but undecodable (the crc already verified the
+            # bytes): typed, not torn — the single-request discipline.
+            self.errors += 1
+            self._enqueue(conn, F_SERR,
+                          encode_error(0, E_BAD_REQUEST, str(e)))
+            return
+        self.inference_requests += 1
+        self.inference_rows += len(rows)
+        self.requests += 1
+        self._source_count(conn.wid, rows=len(rows))
+        futures = []
+        try:
+            for obs in rows:
+                futures.append(self._server.submit(obs))
+        except ServerOverloaded as e:
+            # Whole-request shed: the worker retries the group whole.
+            # Rows already admitted complete unobserved (greedy inference
+            # is pure — serving them costs one padded row each).
+            self.shed += 1
+            self._enqueue(conn, F_SERR,
+                          encode_error(req_id, E_OVERLOADED, str(e)))
+            return
+        except ServerClosed as e:
+            self._enqueue(conn, F_SERR,
+                          encode_error(req_id, E_CLOSED, str(e)))
+            return
+        conn.inflight += 1
+        agg = {"lock": threading.Lock(), "left": len(futures),
+               "rows": [None] * len(futures), "exc": None}
+        for i, fut in enumerate(futures):
+            fut.add_done_callback(
+                lambda f, c=conn, rid=req_id, t=t0, a=agg, k=i:
+                self._inference_row_done(c, rid, t, a, k, f)
+            )
+
+    def _inference_row_done(self, conn: _NetConn, req_id: int, t0: float,
+                            agg: dict, k: int, fut) -> None:
+        """Batcher-thread callback, once per row; the LAST row assembles
+        and queues the F_IREP (or one typed error for the group)."""
+        exc = fut.exception()
+        with agg["lock"]:
+            if exc is not None:
+                agg["exc"] = exc
+            else:
+                agg["rows"][k] = fut.result()
+            agg["left"] -= 1
+            if agg["left"] > 0:
+                return
+        import numpy as np
+
+        conn.inflight -= 1
+        exc = agg["exc"]
+        if exc is not None:
+            if isinstance(exc, ServerClosed):
+                body, kind = encode_error(req_id, E_CLOSED, str(exc)), F_SERR
+            else:
+                self.errors += 1
+                body = encode_error(req_id, E_INTERNAL,
+                                    f"{type(exc).__name__}: {exc}")
+                kind = F_SERR
+            if not self._enqueue(conn, kind, body):
+                self.orphaned += 1
+            return
+        results = agg["rows"]
+        actions = np.asarray([r.action for r in results], np.int32)
+        q = np.stack([np.asarray(r.q_values, np.float32) for r in results])
+        # Version floor: rows may straddle a hot reload (different
+        # batches); the FLEET's freshness claim is the oldest row's.
+        version = min(int(r.param_version) for r in results)
+        body = encode_inference_reply(req_id, actions, version, q)
+        if not self._enqueue(conn, F_IREP, body):
+            self.orphaned += 1
+            return
+        self.replies += 1
+        self.inference_replies += 1
+        self._source_count(conn.wid, replies=1)
+        self.latency.record(time.monotonic() - t0)
+
     def _enqueue(self, conn: _NetConn, kind: int, body: bytes) -> bool:
         """Queue one outbound frame; False if the connection is gone.
         Seq is assigned under the lock, so outbox order == seq order even
@@ -375,6 +549,7 @@ class ServingNetServer:
         schema" — key set pinned by tests/test_obs.py)."""
         with self._lock:
             conns = list(self._conns.values())
+            sources = {k: dict(v) for k, v in self._sources.items()}
         return {
             "port": self.port,
             "connections": len(conns),
@@ -385,7 +560,12 @@ class ServingNetServer:
             "errors": self.errors,
             "torn_frames": self.torn_frames,
             "bad_hellos": self.bad_hellos,
+            "token_rejects": self.token_rejects,
             "orphaned": self.orphaned,
+            "inference_requests": self.inference_requests,
+            "inference_rows": self.inference_rows,
+            "inference_replies": self.inference_replies,
+            "sources": sources,
             "inflight": sum(c.inflight for c in conns),
             "bytes_in": sum(c.bytes_in for c in conns)
             + self._bytes_in_closed,

@@ -626,8 +626,11 @@ def _replay_svc_bench(iters: int = 300, batch: int = 32,
     """``replay_svc``: tools/replay_svc_bench.py in a CPU-pinned
     subprocess (the ``serving_qps`` isolation pattern) — RPC sample vs
     in-process sample at the Atari frame shape, with the codec-off /
-    codec-zlib split and the dedup wire economy on the add path
-    (ROADMAP item 1's bench leg; committed: demos/replay_svc.json)."""
+    codec-zlib / codec-auto split (auto = backpressure-gated reply
+    compression: it must price like off on an unloaded loopback, not
+    like the always-zlib worst case) and the dedup wire economy on the
+    add path (ROADMAP item 1's bench leg; committed:
+    demos/replay_svc.json)."""
     repo = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -643,6 +646,45 @@ def _replay_svc_bench(iters: int = 300, batch: int = 32,
     if proc.returncode != 0:
         tail = (proc.stderr or "").strip()[-400:]
         raise RuntimeError(f"replay_svc_bench rc={proc.returncode}: {tail}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _central_inference_bench(widths: str = "4,16,64",
+                             measure_s: float = 20.0,
+                             ramp_timeout_s: float = 480.0,
+                             skip_kill_leg: bool = False,
+                             timeout_s: float = 2400.0) -> dict:
+    """``central_inference``: tools/central_inference_bench.py in a
+    CPU-pinned subprocess (the ``serving_qps`` isolation pattern —
+    outage-proof, hard timeout) — env-steps/s of PARAMLESS workers
+    (action selection through the serving tier's micro-batcher, SEED
+    style) vs param-holding ones at 4/16/64 worker processes, matched
+    config, plus round-trip percentiles, batch occupancy, the obs wire
+    economy, and the replica-kill leg (the verify-gate smoke's verdict:
+    zero torn / zero drops through a mid-run SIGKILL).  Committed:
+    demos/central_inference.json (ROADMAP item 2's bench leg)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable,
+        os.path.join(repo, "tools", "central_inference_bench.py"),
+        "--widths", widths, "--measure-s", str(measure_s),
+        "--ramp-timeout-s", str(ramp_timeout_s),
+    ]
+    if skip_kill_leg:
+        cmd.append("--skip-kill-leg")
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout_s, env=env,
+        cwd=repo,
+    )
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip()[-400:]
+        raise RuntimeError(
+            f"central_inference_bench rc={proc.returncode}: {tail}"
+        )
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
@@ -1212,6 +1254,17 @@ def main() -> None:
     parser.add_argument("--replay-svc-iters", type=int, default=300)
     parser.add_argument("--replay-svc-capacity", type=int, default=16_384)
     parser.add_argument("--replay-svc-rows", type=int, default=8_192)
+    parser.add_argument("--skip-central-inference", action="store_true",
+                        help="skip the central_inference section "
+                        "(paramless vs param-holding workers at "
+                        "4/16/64 — the longest host-only section: the "
+                        "64-wide legs ramp a real process fleet)")
+    parser.add_argument("--central-widths", default="4,16,64")
+    parser.add_argument("--central-measure-s", type=float, default=20.0)
+    parser.add_argument("--central-skip-kill", action="store_true",
+                        help="skip the central_inference replica-kill "
+                        "leg (the subprocess smoke; CI-tiny bench runs "
+                        "keep the width points only)")
     parser.add_argument("--skip-replay-tiered", action="store_true",
                         help="skip the replay_tiered section (disk-spill "
                         "cold frame store vs in-core)")
@@ -1372,6 +1425,16 @@ def main() -> None:
                 iters=args.replay_svc_iters,
                 capacity=args.replay_svc_capacity,
                 rows=args.replay_svc_rows)
+    if not args.skip_central_inference:
+        # Host-only (CPU-pinned subprocess): SEED-style paramless
+        # workers vs param-holding ones at fleet width — env-steps/s
+        # through the serving tier's micro-batcher, rtt percentiles,
+        # and the replica-kill leg (ROADMAP item 2;
+        # demos/central_inference.json is the committed point set).
+        section("central_inference", _central_inference_bench,
+                widths=args.central_widths,
+                measure_s=args.central_measure_s,
+                skip_kill_leg=args.central_skip_kill)
     if not args.skip_ckpt_stall:
         # Host-only: learner-visible checkpoint stall, full-sync vs the
         # incremental async subsystem, at the 2M-slot dedup layout.

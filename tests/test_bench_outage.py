@@ -38,9 +38,12 @@ def test_bench_emits_host_only_json_during_outage():
         "--replay-svc-iters", "30",          # tiny: mechanism, not scale
         "--replay-svc-capacity", "2048",
         "--replay-svc-rows", "1024",
+        "--central-widths", "2",             # tiny: mechanism, not scale
+        "--central-measure-s", "1.0",
+        "--central-skip-kill",               # the smoke leg is gate 11's
     ]
     proc = subprocess.run(
-        cmd, capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+        cmd, capture_output=True, text=True, timeout=560, env=env, cwd=REPO,
     )
     assert proc.returncode == 0, (proc.stdout[-500:], proc.stderr[-2000:])
     lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
@@ -53,8 +56,12 @@ def test_bench_emits_host_only_json_during_outage():
     # Host-only sections survive the outage...
     for key in ("host_replay_2m", "host_dedup_2m", "serving_qps",
                 "xp_transport", "checkpoint_stall", "pipeline_overlap",
-                "replay_svc"):
+                "replay_svc", "central_inference"):
         assert key in rec, f"missing host-only section {key}"
+    ci = rec["central_inference"]
+    assert "error" not in ci, ci
+    assert all(p["env_steps_per_s"] > 0 for p in ci["points"])
+    assert all(p["torn_replies"] == 0 for p in ci["points"])
     rs = rec["replay_svc"]
     assert "error" not in rs, rs
     assert rs["in_process"]["samples_per_s"] > 0

@@ -184,6 +184,23 @@ def render(snap: dict) -> str:
             f"flushed {rsvc.get('writeback_flushed', 0)}  "
             f"torn {rsvc.get('rpc_torn', 0)}"
         )
+    inf = snap.get("inference")
+    if inf:
+        rtt = inf.get("rtt") or {}
+        lag = inf.get("version_lag")
+        occ = inf.get("batch_occupancy_mean")
+        lines.append(
+            f"-- inference  {inf.get('mode', '-')}  "
+            f"{inf.get('replies', 0)} replies "
+            f"({inf.get('workers_reporting', 0)} workers)  "
+            f"rtt p50/p99 {rtt.get('p50_ms', '-')}/"
+            f"{rtt.get('p99_ms', '-')} ms  "
+            f"occ {occ if occ is not None else '-'}  "
+            f"lag {lag if lag is not None else '-'}  "
+            f"stall {inf.get('stall_ms', 0)} ms  "
+            f"torn {inf.get('torn_replies', 0)}  "
+            f"fb {inf.get('fallback_steps', 0)}"
+        )
     snet = snap.get("serving_net") or (snap.get("serving") or {}).get("net")
     if snet:
         lat = snet.get("latency") or {}

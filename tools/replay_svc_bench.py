@@ -113,9 +113,14 @@ def main(argv=None) -> int:
     # worst-case codec CPU (deflate tried, discarded as not-smaller on
     # replies; the dedup layer still wins on the overlapping add path)
     # while the off leg prices pure framing+copy+syscall.
-    for shards, codec in ((1, "off"), (1, "zlib"), (2, "off")):
-        leg_name = f"rpc_{shards}shard" + ("_zlib" if codec == "zlib"
-                                           else "")
+    # codec=auto is the PR-12 gate: the hello still negotiates the zlib
+    # CAPABILITY, but the shard compresses sample replies only while its
+    # reply sends observe kernel-buffer backpressure — on an unloaded
+    # loopback it should price like the off leg, not the zlib one.
+    for shards, codec in ((1, "off"), (1, "zlib"), (1, "auto"), (2, "off")):
+        leg_name = f"rpc_{shards}shard" + (
+            f"_{codec}" if codec != "off" else ""
+        )
         root = tempfile.mkdtemp(prefix=f"rsvc-bench-{shards}{codec}-")
         fleet = ReplayServiceFleet(
             shards, args.capacity, obs_shape, root_dir=root, codec=codec,
@@ -130,7 +135,7 @@ def main(argv=None) -> int:
             _fill(cl, rng, args.rows, obs_shape)
             leg = _measure(cl, rng, args.iters, args.batch)
             # Wire economy on the RPC plane (shard-side accounting).
-            wire = logical = 0
+            wire = logical = zlib_n = raw_n = fw = 0
             for s in fleet.shards:
                 sc = ShardClient(s.shard_id, "127.0.0.1", s.port,
                                  token=fleet.token, client_id=77,
@@ -138,18 +143,25 @@ def main(argv=None) -> int:
                 st = sc.shard_stats(timeout=10.0)
                 wire += st["bytes_in"]
                 logical += st["logical_bytes_in"]
+                zlib_n += st.get("reply_zlib", 0)
+                raw_n += st.get("reply_raw", 0)
+                fw += st.get("reply_full_waits", 0)
                 sc.close()
             leg["add_wire_over_logical"] = (
                 round(wire / logical, 4) if logical else None
             )
             leg["codec"] = codec
+            leg["reply_zlib"] = zlib_n
+            leg["reply_raw"] = raw_n
+            leg["reply_full_waits"] = fw
             report[leg_name] = leg
         finally:
             cl.close()
             fleet.stop()
 
     base = report["in_process"]["samples_per_s"]
-    for k in ("rpc_1shard", "rpc_1shard_zlib", "rpc_2shard"):
+    for k in ("rpc_1shard", "rpc_1shard_zlib", "rpc_1shard_auto",
+              "rpc_2shard"):
         if k in report and base:
             report[k]["vs_in_process"] = round(
                 report[k]["samples_per_s"] / base, 3

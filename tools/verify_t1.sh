@@ -59,7 +59,18 @@
 #         against the frozen chain), write-backs must flush, and no
 #         torn frame may appear on either side
 #         (tools/replay_svc_smoke.py).
-# Gate 11: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
+# Gate 11: central-inference smoke — the SEED-style production story end
+#         to end: a 2-replica routed serving fleet (serve.py children
+#         with the trainer's --run-token), a process-actor trainer whose
+#         workers are PARAMLESS (actor.inference=central, every action
+#         selected through the router into a replica's micro-batcher,
+#         ε worker-side on the global ladder slice), trainer publishes
+#         fanned to the fleet as page-deltas, one replica SIGKILLed
+#         mid-run; training must reach its step target with zero torn
+#         frames on either side, zero worker deaths, fresh
+#         param_version in replies, and the replica respawned
+#         (tools/central_inference_smoke.py).
+# Gate 12: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
 #         command changes, change it HERE too (they must stay
 #         character-identical modulo this wrapper's cd).
 cd "$(dirname "$0")/.." || exit 1
@@ -73,4 +84,5 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/spill_smoke.py > /tmp/_t1_s
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/net_smoke.py > /tmp/_t1_net.log 2>&1 || { echo "net smoke FAILED:"; cat /tmp/_t1_net.log; exit 1; }
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/serving_net_smoke.py > /tmp/_t1_snet.log 2>&1 || { echo "serving-net smoke FAILED:"; cat /tmp/_t1_snet.log; exit 1; }
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/replay_svc_smoke.py > /tmp/_t1_rsvc.log 2>&1 || { echo "replay-svc smoke FAILED:"; cat /tmp/_t1_rsvc.log; exit 1; }
+timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/central_inference_smoke.py > /tmp/_t1_central.log 2>&1 || { echo "central-inference smoke FAILED:"; cat /tmp/_t1_central.log; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
