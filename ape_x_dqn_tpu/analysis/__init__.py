@@ -1,0 +1,81 @@
+"""apexlint — repo-native static analysis enforcing the fleet's invariants.
+
+Six checkers, each derived from a contract the repo already states in
+prose (docstrings, docs/METRICS.md, the leak guard, the adversarial-
+decode tests) but until now enforced only by convention:
+
+  ==================  =====================================================
+  checker id          contract
+  ==================  =====================================================
+  import-light        contracted child-process modules never reach
+                      jax/flax/optax through any transitive module-scope
+                      import (static module-graph walk incl. package
+                      ``__init__`` chains)
+  wire-registry       every ``F_*`` frame kind / protocol magic declared
+                      once in runtime/net.py, unique, no duplicated
+                      literals at decode sites
+  config-coverage     every ``cfg.<section>.<knob>`` read resolves to a
+                      declared field; every declared knob is documented
+  metrics-doc         every literal registry instrument / provider /
+                      JSONL-section name appears in docs/METRICS.md
+  shm-discipline      SharedMemory creation flows through the
+                      session-prefix helpers (leak-guard attribution)
+  typed-errors        no bare ``except:``; silent broad swallows carry an
+                      in-place ``# noqa: BLE001 — reason``
+  ==================  =====================================================
+
+See docs/INVARIANTS.md for the operator-facing table (what to do when a
+checker fires) and ``python -m tools.lint --help`` for the CLI.  The
+package is import-light by its own contract: stdlib only.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from ape_x_dqn_tpu.analysis import (
+    config_coverage,
+    import_light,
+    metrics_doc,
+    shm_discipline,
+    typed_errors,
+    wire_registry,
+)
+from ape_x_dqn_tpu.analysis.core import (
+    BASELINE_PATH,
+    Finding,
+    LintResult,
+    Repo,
+    apply_baseline,
+    load_baseline,
+    run_checkers,
+    write_baseline,
+)
+
+#: checker id -> Repo -> findings (production defaults; tests call the
+#: modules' ``check`` directly with fixture options).
+CHECKERS: Dict[str, Callable[[Repo], List[Finding]]] = {
+    import_light.CHECKER: import_light.check,
+    wire_registry.CHECKER: wire_registry.check,
+    config_coverage.CHECKER: config_coverage.check,
+    metrics_doc.CHECKER: metrics_doc.check,
+    shm_discipline.CHECKER: shm_discipline.check,
+    typed_errors.CHECKER: typed_errors.check,
+}
+
+
+def run_all(repo: Repo, only=None) -> List[Finding]:
+    return run_checkers(repo, CHECKERS, only=only)
+
+
+__all__ = [
+    "BASELINE_PATH",
+    "CHECKERS",
+    "Finding",
+    "LintResult",
+    "Repo",
+    "apply_baseline",
+    "load_baseline",
+    "run_all",
+    "write_baseline",
+]

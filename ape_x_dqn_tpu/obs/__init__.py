@@ -10,34 +10,45 @@ checkpoint writer, serving) — see the module docstrings:
   * ``recorder``  — flight recorder + post-mortem dumps
   * ``trace``     — /varz?trace=1 on-demand jax.profiler capture
 
-Import-light by contract (stdlib + numpy + utils.metrics): worker
-children import ``shm_stats``/``recorder`` before jax exists.
+Lazy by contract (PEP 562): worker children import ``shm_stats`` before
+jax exists, and ``import ape_x_dqn_tpu.obs.shm_stats`` executes this
+file first — so the re-exports below resolve on first attribute access
+instead of importing the exporter/lineage/trace stack eagerly (enforced
+by the ``import-light`` checker).
 """
 
-from ape_x_dqn_tpu.obs.exporter import ObsServer
-from ape_x_dqn_tpu.obs.lineage import LineageTracker
-from ape_x_dqn_tpu.obs.recorder import FlightRecorder, write_postmortem
-from ape_x_dqn_tpu.obs.registry import (
-    Counter,
-    Gauge,
-    Health,
-    Histogram,
-    MetricsRegistry,
-)
-from ape_x_dqn_tpu.obs.shm_stats import WORKER_SLOTS, WorkerStatsBlock
-from ape_x_dqn_tpu.obs.trace import TraceOnDemand
+from __future__ import annotations
 
-__all__ = [
-    "Counter",
-    "FlightRecorder",
-    "Gauge",
-    "Health",
-    "Histogram",
-    "LineageTracker",
-    "MetricsRegistry",
-    "ObsServer",
-    "TraceOnDemand",
-    "WORKER_SLOTS",
-    "WorkerStatsBlock",
-    "write_postmortem",
-]
+import importlib
+
+_LAZY = {
+    "ObsServer": "ape_x_dqn_tpu.obs.exporter",
+    "LineageTracker": "ape_x_dqn_tpu.obs.lineage",
+    "FlightRecorder": "ape_x_dqn_tpu.obs.recorder",
+    "write_postmortem": "ape_x_dqn_tpu.obs.recorder",
+    "Counter": "ape_x_dqn_tpu.obs.registry",
+    "Gauge": "ape_x_dqn_tpu.obs.registry",
+    "Health": "ape_x_dqn_tpu.obs.registry",
+    "Histogram": "ape_x_dqn_tpu.obs.registry",
+    "MetricsRegistry": "ape_x_dqn_tpu.obs.registry",
+    "WORKER_SLOTS": "ape_x_dqn_tpu.obs.shm_stats",
+    "WorkerStatsBlock": "ape_x_dqn_tpu.obs.shm_stats",
+    "TraceOnDemand": "ape_x_dqn_tpu.obs.trace",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is not None:
+        return getattr(importlib.import_module(target), name)
+    try:
+        return importlib.import_module(f"{__name__}.{name}")
+    except ModuleNotFoundError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

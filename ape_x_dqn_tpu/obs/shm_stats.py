@@ -43,7 +43,9 @@ import time
 from multiprocessing import shared_memory
 from typing import Dict, List, Optional, Sequence, Tuple
 
-_MAGIC = b"APXS"
+_MAGIC = b"APXO"   # Obs stats block (was b"APXS", which collided with
+#                    the replay cold-span record magic — never persisted
+#                    across sessions, so the rename is free)
 _VERSION = 1
 
 # Header (64 bytes, all fields 8-byte aligned):

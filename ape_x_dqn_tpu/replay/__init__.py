@@ -1,8 +1,38 @@
 """Replay subsystem: sum-tree priorities + prioritized ring-buffer stores
-(double-store, frame-dedup, and their HBM device twins)."""
+(double-store, frame-dedup, and their HBM device twins).
 
-from ape_x_dqn_tpu.replay.buffer import PrioritizedReplay
-from ape_x_dqn_tpu.replay.dedup import DedupReplay
-from ape_x_dqn_tpu.replay.sum_tree import SumTree
+Lazy by contract (PEP 562): ``replay.service`` hosts the shard-server
+path that spawns as a no-jax subprocess (``python -m
+ape_x_dqn_tpu.replay.service``), and importing it executes this file
+first.  ``buffer``/``dedup`` reach ``types`` (jax) at module scope, so
+eager re-exports here put the whole device runtime on every shard spawn;
+the names below resolve on first attribute access instead (enforced by
+the ``import-light`` checker).
+"""
 
-__all__ = ["DedupReplay", "PrioritizedReplay", "SumTree"]
+from __future__ import annotations
+
+import importlib
+
+_LAZY = {
+    "PrioritizedReplay": "ape_x_dqn_tpu.replay.buffer",
+    "DedupReplay": "ape_x_dqn_tpu.replay.dedup",
+    "SumTree": "ape_x_dqn_tpu.replay.sum_tree",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is not None:
+        return getattr(importlib.import_module(target), name)
+    try:
+        return importlib.import_module(f"{__name__}.{name}")
+    except ModuleNotFoundError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

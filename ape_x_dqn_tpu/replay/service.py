@@ -55,7 +55,7 @@ lives in the learner's address space.  This module is that layer:
 
 Hello handshake (one struct each way, before any framing state):
 
-    client → shard:  4s "APXR" | u32 version | i64 client_id | i64
+    client → shard:  4s "APXV" | u32 version | i64 client_id | i64
                      shard_id | i64 incarnation | i64 token | u8 codec
     shard  → client: 4s "APXA" | u32 version | i64 shard_id | i64
                      incarnation | i64 capacity | i64 count
@@ -96,6 +96,8 @@ from ape_x_dqn_tpu.runtime.net import (
     F_RERR,
     F_RREP,
     F_RREQ,
+    RSVC_ACK_MAGIC,
+    RSVC_MAGIC,
     Backoff,
     FrameParser,
     decode_xpb_payload,
@@ -104,8 +106,6 @@ from ape_x_dqn_tpu.runtime.net import (
 )
 from ape_x_dqn_tpu.runtime.shm_ring import XP, decode_chunk, encode_chunk_parts
 
-RSVC_MAGIC = b"APXR"
-RSVC_ACK_MAGIC = b"APXA"
 RSVC_VERSION = 1
 # magic, version, client_id, shard_id, incarnation, token, codec
 RSVC_HELLO = struct.Struct("<4sIqqqqB7x")
@@ -1158,7 +1158,7 @@ class ShardedReplayClient:
         if self._on_event is not None:
             try:
                 self._on_event(kind, **fields)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — observer callback must never break the fleet/client
                 pass
 
     # -- the probe/recovery loop -------------------------------------------
@@ -1686,7 +1686,7 @@ class ReplayServiceFleet:
         if self._on_event is not None:
             try:
                 self._on_event(kind, **fields)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — observer callback must never break the fleet/client
                 pass
 
     # -- endpoints ---------------------------------------------------------

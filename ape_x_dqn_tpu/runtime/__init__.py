@@ -1,40 +1,51 @@
 """Runtimes: deterministic single-process driver + async pipeline +
-process-parallel actor workers."""
+process-parallel actor workers.
 
-from ape_x_dqn_tpu.runtime.async_pipeline import AsyncPipeline
-from ape_x_dqn_tpu.runtime.components import Components, build_components
-from ape_x_dqn_tpu.runtime.fused_learner import FusedDeviceLearner
-from ape_x_dqn_tpu.runtime.infeed import PrefetchQueue
-from ape_x_dqn_tpu.runtime.param_store import ParamStore
-from ape_x_dqn_tpu.runtime.process_actors import (
-    ProcessActorPool,
-    ProcessActorWorker,
-    SharedMemoryParamStore,
-    SharedParamBuffer,
-)
-from ape_x_dqn_tpu.runtime.single_process import SingleProcessDriver, beta_schedule
-from ape_x_dqn_tpu.runtime.supervisor import (
-    FleetSupervisor,
-    LearnerWatchdog,
-    RespawnPolicy,
-    ServingStalenessPolicy,
-)
+Lazy by contract (PEP 562): ``runtime.net`` and ``runtime.shm_ring`` are
+import-light modules loaded inside no-jax child processes (replay shard
+servers, remote workers, bench producers), and ``import
+ape_x_dqn_tpu.runtime.net`` executes THIS file first.  Eagerly importing
+the pipeline/learner stack here handed every such child the full
+jax/optax import; the re-exports below resolve on first attribute access
+instead (enforced by the ``import-light`` checker).
+"""
 
-__all__ = [
-    "AsyncPipeline",
-    "FleetSupervisor",
-    "LearnerWatchdog",
-    "RespawnPolicy",
-    "ServingStalenessPolicy",
-    "Components",
-    "FusedDeviceLearner",
-    "ParamStore",
-    "PrefetchQueue",
-    "ProcessActorPool",
-    "ProcessActorWorker",
-    "SharedMemoryParamStore",
-    "SharedParamBuffer",
-    "SingleProcessDriver",
-    "beta_schedule",
-    "build_components",
-]
+from __future__ import annotations
+
+import importlib
+
+_LAZY = {
+    "AsyncPipeline": "ape_x_dqn_tpu.runtime.async_pipeline",
+    "Components": "ape_x_dqn_tpu.runtime.components",
+    "build_components": "ape_x_dqn_tpu.runtime.components",
+    "FusedDeviceLearner": "ape_x_dqn_tpu.runtime.fused_learner",
+    "PrefetchQueue": "ape_x_dqn_tpu.runtime.infeed",
+    "ParamStore": "ape_x_dqn_tpu.runtime.param_store",
+    "ProcessActorPool": "ape_x_dqn_tpu.runtime.process_actors",
+    "ProcessActorWorker": "ape_x_dqn_tpu.runtime.process_actors",
+    "SharedMemoryParamStore": "ape_x_dqn_tpu.runtime.process_actors",
+    "SharedParamBuffer": "ape_x_dqn_tpu.runtime.process_actors",
+    "SingleProcessDriver": "ape_x_dqn_tpu.runtime.single_process",
+    "beta_schedule": "ape_x_dqn_tpu.runtime.single_process",
+    "FleetSupervisor": "ape_x_dqn_tpu.runtime.supervisor",
+    "LearnerWatchdog": "ape_x_dqn_tpu.runtime.supervisor",
+    "RespawnPolicy": "ape_x_dqn_tpu.runtime.supervisor",
+    "ServingStalenessPolicy": "ape_x_dqn_tpu.runtime.supervisor",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is not None:
+        return getattr(importlib.import_module(target), name)
+    try:
+        return importlib.import_module(f"{__name__}.{name}")
+    except ModuleNotFoundError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -1028,7 +1028,7 @@ class AsyncPipeline:
         if self._ckpt_inc is not None:
             try:
                 self._ckpt_inc.close(timeout=30.0)
-            except Exception:
+            except Exception:  # noqa: BLE001 — exit-path teardown; writer errors surfaced via _finish_checkpoints
                 pass
 
     def _flush_priority_writeback(self, pending: list) -> None:

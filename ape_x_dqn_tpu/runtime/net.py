@@ -154,6 +154,13 @@ _AUTO_OFF_FLUSHES = 256   # net_codec=auto: raw again after this many
 SERVE_MAGIC = b"APXQ"
 SERVE_VERSION = 1
 SERVE_VERSION_EXT = 2
+# Replay-service hello magics (replay/service.py speaks them; declared
+# HERE because net.py is the registry of every wire-plane magic — one
+# place to see that no two protocols share a handshake byte pattern.
+# The hello magic was b"APXR" until apexlint's wire-registry checker
+# caught it colliding with shm_ring's ring-header magic.
+RSVC_MAGIC = b"APXV"
+RSVC_ACK_MAGIC = b"APXA"
 SERVE_HELLO = struct.Struct("<4sI")
 SERVE_HELLO_EXT = struct.Struct("<qqqB7x")   # wid, attempt, token, codec
 # Request: u64 req_id | u8 ndim | u8 dtype (0=uint8) | 6x pad | u32 dims…

@@ -618,11 +618,11 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                     "t": round(time.monotonic(), 4), "kind": "error",
                     "error": f"{type(e).__name__}: {e}",
                 })
-            except Exception:
+            except Exception:  # noqa: BLE001 — dying worker: the stats block may already be gone
                 pass
         try:
             ctl_queue.put(("error", worker_id, f"{type(e).__name__}: {e}"))
-        except Exception:
+        except Exception:  # noqa: BLE001 — last-breath error report; the queue may be closed
             pass
     finally:
         if selector is not None:
@@ -1197,7 +1197,7 @@ class ProcessActorPool:
                     got = True
                 except queue_mod.Empty:
                     continue
-                except Exception:  # torn pickle from a killed mid-put writer
+                except Exception:  # noqa: BLE001 — torn pickle from a killed mid-put writer; the record is unrecoverable by design
                     continue
             for wid, ring in list(self._rings.items()):
                 # Round-robin fairness: a few records per ring per pass, so

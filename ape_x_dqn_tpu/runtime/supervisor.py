@@ -195,7 +195,7 @@ class LearnerWatchdog:
         if self._on_event is not None:
             try:
                 self._on_event(kind, **fields)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — observer callback must never break supervision
                 pass
 
 
@@ -232,7 +232,7 @@ class ServingStalenessPolicy:
                         param_age_s=round(self.age_s(), 3),
                         stale_after_s=self.stale_after_s,
                     )
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — staleness events are telemetry; shedding still happens
                     pass
         return stale
 

@@ -538,7 +538,7 @@ class ServingFleet:
         if self._on_event is not None:
             try:
                 self._on_event(kind, **fields)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — observer callback must not kill routing
                 pass
 
     # -- param distribution ------------------------------------------------

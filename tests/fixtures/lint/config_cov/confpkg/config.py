@@ -1,0 +1,16 @@
+"""Fixture config module: ApexConfig with one section, one undocumented
+knob (ghost_target is declared but the fixture doc never mentions it)."""
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class ActorConfig:
+    num_actors: int = 5
+    documented_knob: int = 1
+    ghost_target: int = 0     # line 11: declared, never documented
+
+
+@dataclasses.dataclass
+class ApexConfig:
+    actor: ActorConfig = dataclasses.field(default_factory=ActorConfig)

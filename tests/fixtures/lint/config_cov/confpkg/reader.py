@@ -1,0 +1,8 @@
+"""Fixture reader: one good read, one ghost attribute, one ghost getattr."""
+
+
+def use(cfg):
+    a = cfg.actor.num_actors            # declared: fine
+    b = cfg.actor.ghost_knob            # line 6: ghost knob
+    c = getattr(cfg.actor, "ghost_via_getattr", 0)   # line 7: ghost knob
+    return a, b, c

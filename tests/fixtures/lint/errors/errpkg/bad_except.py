@@ -1,0 +1,30 @@
+"""Fixture handlers: bare except + unjustified silent swallow are
+findings; the justified and the narrow variants are not."""
+
+
+def decode(buf):
+    try:
+        return buf.decode()
+    except:                     # line 8: bare except
+        return None
+
+
+def cleanup(sock):
+    try:
+        sock.close()
+    except Exception:           # line 15: silent swallow, no reason
+        pass
+
+
+def justified(sock):
+    try:
+        sock.close()
+    except Exception:  # noqa: BLE001 — best-effort close on teardown
+        pass
+
+
+def narrow(sock):
+    try:
+        sock.close()
+    except OSError:
+        pass

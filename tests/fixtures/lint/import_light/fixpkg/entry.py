@@ -1,0 +1,4 @@
+"""Contracted-light entry module: imports no heavy lib itself, but its
+transitive module-scope import chain smuggles jax in via middle.py."""
+
+from fixpkg.middle import helper  # noqa: F401

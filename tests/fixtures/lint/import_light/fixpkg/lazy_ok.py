@@ -1,0 +1,7 @@
+"""The blessed escape hatch: function-scope imports are lazy and legal."""
+
+
+def fine():
+    import jax  # function scope — never runs at import time
+
+    return jax

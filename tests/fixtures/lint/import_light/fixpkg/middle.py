@@ -1,0 +1,7 @@
+"""The smuggler: a module-scope jax import two hops from the entry."""
+
+import jax  # line 3: the violation the import-light walk must find
+
+
+def helper():
+    return jax

@@ -1,0 +1,7 @@
+"""Fixture metrics registrations: one documented, two not."""
+
+
+def setup(reg, pipe):
+    reg.counter("good/counter")                       # documented: fine
+    reg.gauge("bad/undocumented_gauge")               # line 6: finding
+    pipe.register_jsonl_section("ghost_section", dict)  # line 7: finding

@@ -1,0 +1,11 @@
+"""Fixture shm sites: a raw create (bad) and an attach (fine)."""
+
+from multiprocessing import shared_memory
+
+
+def make():
+    return shared_memory.SharedMemory(create=True, size=64)   # line 7: bad
+
+
+def attach(name):
+    return shared_memory.SharedMemory(name=name)              # attach: fine

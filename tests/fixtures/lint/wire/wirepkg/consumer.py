@@ -1,0 +1,17 @@
+"""Fixture decode site: every wire-registry violation in one file."""
+
+F_D = 9              # line 3: kind declared outside the registry
+MAGIC_TWO = b"TSTA"  # line 4: duplicate magic value
+
+
+def decode(kind, payload):
+    # line 8+: dispatches on a registered kind with NO rejection path
+    if kind == F_A:  # noqa: F821 — fixture is parsed, never imported
+        return payload
+    return None
+
+
+def route(frame_kind):
+    if frame_kind == 2:  # line 15: raw literal collides with F_C's value
+        return True
+    return False

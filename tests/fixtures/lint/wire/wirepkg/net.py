@@ -1,0 +1,6 @@
+"""Fixture wire registry: F_B duplicates F_A's value; F_C is dead."""
+
+F_A = 1
+F_B = 1            # line 4: duplicate kind value
+F_C = 2            # declared but never referenced anywhere -> dead kind
+MAGIC_ONE = b"TSTA"

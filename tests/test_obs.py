@@ -344,22 +344,20 @@ class TestLineageTracker:
 
 
 def _doc_keys(section_header):
-    with open(os.path.join(REPO, "docs", "METRICS.md")) as f:
-        text = f.read()
-    section = text.split(section_header, 1)[1]
-    keys = []
-    for line in section.splitlines():
-        line = line.strip()
-        if line.startswith("- `"):
-            keys.append(line.split("`")[1])
-        elif line.startswith("## "):
-            break
-    return keys
+    # One shared parser now lives with the analyzer (apexlint satellite):
+    # the standalone dict-vs-doc pins moved to tests/test_lint.py
+    # TestDocSchemaDicts; the pins below need this module's run fixtures.
+    from ape_x_dqn_tpu.analysis.metrics_doc import doc_section_keys
+
+    return doc_section_keys(
+        section_header, os.path.join(REPO, "docs", "METRICS.md"))
 
 
 class TestMetricsDocSchema:
     """docs/METRICS.md is a contract: the stamped-keys list and the
-    periodic core-key list must match real emitted records exactly."""
+    periodic core-key list must match real emitted records exactly.
+    (Thin pin retained here — the fixture-free schema-dict pins and the
+    static metrics-doc checker live in tests/test_lint.py.)"""
 
     def test_stamp_keys_match_doc(self):
         from ape_x_dqn_tpu.utils.metrics import emit_event
@@ -398,91 +396,9 @@ class TestMetricsDocSchema:
         assert "apex_supervisor_respawns_total" \
             in pipe.obs_registry.prometheus_text()
 
-    def test_replay_tier_section_matches_doc(self, tmp_path):
-        """The replay-tier schema rows (ISSUE 7 satellite): the documented
-        key list IS the tier_stats dict that rides the JSONL
-        ``replay_tier`` section and the /varz provider."""
-        import numpy as np
-
-        from ape_x_dqn_tpu.replay.dedup import DedupReplay
-        from ape_x_dqn_tpu.types import DedupChunk
-
-        doc = _doc_keys("## Replay tier schema")
-        assert doc, "Replay tier schema doc section missing"
-        rep = DedupReplay(64, (6, 6, 1), hot_frame_budget_bytes=128,
-                          spill_dir=str(tmp_path), spill_span_frames=4)
-        r = np.random.default_rng(0)
-        rep.add(
-            (np.abs(r.normal(size=8)) + 0.1).astype(np.float32),
-            DedupChunk(
-                frames=r.integers(0, 255, (9, 6, 6, 1), dtype=np.uint8),
-                obs_ref=np.arange(8, dtype=np.int32),
-                next_ref=np.arange(1, 9, dtype=np.int32),
-                action=r.integers(0, 3, 8).astype(np.int32),
-                reward=r.normal(size=8).astype(np.float32),
-                discount=np.full(8, 0.9, np.float32),
-                source=1, chunk_seq=0, prev_frames=9,
-            ),
-        )
-        rep.spill_cold()
-        rep.sample(8, rng=np.random.default_rng(1))  # faults cold spans
-        stats = rep.tier_stats()
-        assert stats["fault_reads"] > 0
-        assert set(doc) == set(stats), set(doc) ^ set(stats)
-        for key in ("count", "mean_ms", "p50_ms", "p95_ms", "p99_ms",
-                    "max_ms"):
-            assert key in stats["fault_ms"], key
-
-    def test_net_section_matches_doc(self):
-        """The net-transport schema rows (ISSUE 8 satellite): the
-        documented key list IS the stats dict that rides the JSONL
-        ``net`` section and the /varz provider on the tcp backend."""
-        from ape_x_dqn_tpu.runtime.net import NetTransport
-
-        doc = _doc_keys("## Net transport schema")
-        assert doc, "Net transport schema doc section missing"
-        tr = NetTransport()
-        try:
-            stats = tr.stats()
-        finally:
-            tr.close()
-        assert set(doc) == set(stats), set(doc) ^ set(stats)
-
-    def test_serving_net_section_matches_doc(self):
-        """The serving-net schema rows (ISSUE 9 satellite): the
-        documented key list IS ServingNetServer.stats() — the JSONL
-        ``serving_net`` section and /varz ``serving.net``."""
-        from ape_x_dqn_tpu.serving.net_server import ServingNetServer
-
-        class _Stub:
-            param_version = 0
-
-            def submit(self, obs):
-                raise AssertionError("never called")
-
-        doc = _doc_keys("## Serving net schema")
-        assert doc, "Serving net schema doc section missing"
-        srv = ServingNetServer(_Stub())
-        try:
-            stats = srv.stats()
-        finally:
-            srv.close()
-        assert set(doc) == set(stats), set(doc) ^ set(stats)
-
-    def test_serving_router_section_matches_doc(self):
-        """The serving-router schema rows (ISSUE 9 satellite): the
-        documented key list IS ServingRouter.stats() — the JSONL
-        ``serving_router`` section and the fleet /varz provider."""
-        from ape_x_dqn_tpu.serving.router import ServingRouter
-
-        doc = _doc_keys("## Serving router schema")
-        assert doc, "Serving router schema doc section missing"
-        router = ServingRouter(port=0)
-        try:
-            stats = router.stats()
-        finally:
-            router.close()
-        assert set(doc) == set(stats), set(doc) ^ set(stats)
+    # test_replay_tier/net/serving_net/serving_router_section_matches_doc
+    # moved to tests/test_lint.py::TestDocSchemaDicts (apexlint absorbs
+    # the fixture-free doc pins; same parser, same assertions).
 
 
 @pytest.fixture(scope="module")

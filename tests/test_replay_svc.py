@@ -657,17 +657,12 @@ class TestChaosPlumbing:
 
 
 def _doc_keys(section_header):
-    with open(os.path.join(REPO, "docs", "METRICS.md")) as f:
-        text = f.read()
-    section = text.split(section_header, 1)[1]
-    keys = []
-    for line in section.splitlines():
-        line = line.strip()
-        if line.startswith("- `"):
-            keys.append(line.split("`")[1])
-        elif line.startswith("## "):
-            break
-    return keys
+    # Shared parser (apexlint satellite): one implementation in
+    # ape_x_dqn_tpu/analysis/metrics_doc.py serves every schema pin.
+    from ape_x_dqn_tpu.analysis.metrics_doc import doc_section_keys
+
+    return doc_section_keys(
+        section_header, os.path.join(REPO, "docs", "METRICS.md"))
 
 
 class TestReplaySvcDocSchema:

@@ -1,0 +1,3 @@
+# Namespace marker so `python -m tools.lint` works from the repo root.
+# Every script in here stays directly runnable (`python tools/foo.py`);
+# nothing may import heavyweight modules at tools-package scope.
