@@ -465,6 +465,51 @@ class ObsConfig:
     trace_steps: int = 512
     # Trace output root; None → a fresh temp dir per capture.
     trace_dir: Optional[str] = None
+    # --- fleet observability plane (obs/fleet.py) ---
+    # Aggregator scrape cadence: every endpoint (trainer /varz, replay
+    # shards' stats RPC, serving replicas' /varz) is polled once per
+    # interval; one dead scrape marks that endpoint down with a
+    # scrape_failures count, never a sweep crash.
+    fleet_scrape_interval_s: float = 1.0
+    # Per-scrape timeout (HTTP and the shard stats RPC alike): a wedged
+    # endpoint costs the sweep this much, not a hang.
+    fleet_scrape_timeout_s: float = 2.0
+    # Rollup exporter port for tools that mount the aggregator
+    # (tools/obs_top.py --fleet scrapes it; tools/fleet_obs_smoke.py).
+    # None = the mounting tool picks; 0 = ephemeral.
+    fleet_port: Optional[int] = None
+    # --- declarative SLO rules over the rollup (0 = rule off) ---
+    # Age-of-experience ceiling: breach while the fleet-merged
+    # age-at-sample p95 exceeds this many milliseconds.
+    fleet_slo_age_p95_ms: float = 0.0
+    # Central-inference round-trip ceiling: breach while the worst
+    # trainer's rtt p99 exceeds this (ms).
+    fleet_slo_inference_rtt_p99_ms: float = 0.0
+    # Serving-latency ceiling: breach while the replica-merged request
+    # p99 exceeds this (ms).
+    fleet_slo_serving_p99_ms: float = 0.0
+    # Serving-throughput floor: breach while summed replica QPS (scrape-
+    # to-scrape reply deltas) falls under this.
+    fleet_slo_serving_qps_min: float = 0.0
+    # Ring-occupancy band, as fractions of actor.xp_ring_bytes: breach
+    # while the worst worker's backlog sits above high (drain too slow)
+    # or below low (actors starved).  Defaults (0, 1] leave both off.
+    fleet_slo_ring_occupancy_low: float = 0.0
+    fleet_slo_ring_occupancy_high: float = 1.0
+    # Endpoint-liveness rule (on by default): breach while any
+    # registered endpoint is failing its scrapes.
+    fleet_slo_endpoint_alive: bool = True
+    # Burn-rate window: a rule transitions on the breaching FRACTION of
+    # the trailing window, not a single sample.
+    fleet_slo_window_s: float = 30.0
+    # ok->breach fires at burn >= this fraction of the window...
+    fleet_slo_burn_threshold: float = 0.5
+    # ...and breach->ok only at burn <= this (the hysteresis band
+    # between them damps flapping around the bound).
+    fleet_slo_clear_threshold: float = 0.1
+    # Minimum window samples before ANY transition (one bad scrape is
+    # not a breach; one good one is not a recovery).
+    fleet_slo_min_samples: int = 3
 
 
 @dataclasses.dataclass
@@ -626,6 +671,32 @@ class ApexConfig:
             (o.heartbeat_stale_s > 0.0,
              "obs.heartbeat_stale_s must be > 0"),
             (o.trace_steps >= 1, "obs.trace_steps must be >= 1"),
+            (o.fleet_scrape_interval_s > 0.0,
+             "obs.fleet_scrape_interval_s must be > 0"),
+            (o.fleet_scrape_timeout_s > 0.0,
+             "obs.fleet_scrape_timeout_s must be > 0"),
+            (o.fleet_port is None or 0 <= o.fleet_port <= 65535,
+             "obs.fleet_port must be None or in [0, 65535]"),
+            (o.fleet_slo_age_p95_ms >= 0.0,
+             "obs.fleet_slo_age_p95_ms must be >= 0"),
+            (o.fleet_slo_inference_rtt_p99_ms >= 0.0,
+             "obs.fleet_slo_inference_rtt_p99_ms must be >= 0"),
+            (o.fleet_slo_serving_p99_ms >= 0.0,
+             "obs.fleet_slo_serving_p99_ms must be >= 0"),
+            (o.fleet_slo_serving_qps_min >= 0.0,
+             "obs.fleet_slo_serving_qps_min must be >= 0"),
+            (0.0 <= o.fleet_slo_ring_occupancy_low
+             <= o.fleet_slo_ring_occupancy_high <= 1.0,
+             "obs.fleet_slo_ring_occupancy band must satisfy "
+             "0 <= low <= high <= 1"),
+            (o.fleet_slo_window_s > 0.0,
+             "obs.fleet_slo_window_s must be > 0"),
+            (0.0 <= o.fleet_slo_clear_threshold
+             <= o.fleet_slo_burn_threshold <= 1.0,
+             "obs.fleet_slo thresholds must satisfy "
+             "0 <= clear <= burn <= 1"),
+            (o.fleet_slo_min_samples >= 1,
+             "obs.fleet_slo_min_samples must be >= 1"),
             (s.max_batch >= 1, "serving.max_batch must be >= 1"),
             (s.max_wait_ms >= 0.0, "serving.max_wait_ms must be >= 0"),
             (s.queue_capacity >= s.max_batch,
@@ -881,7 +952,7 @@ def from_reference_json(data: dict) -> ApexConfig:
 _OPTIONAL_FIELDS = {
     "state_shape", "action_dim", "max_grad_norm",
     "second_moment_dtype", "target_dtype", "param_dtype",
-    "export_port", "postmortem_dir", "trace_dir",
+    "export_port", "postmortem_dir", "trace_dir", "fleet_port",
 }
 
 

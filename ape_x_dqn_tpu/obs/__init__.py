@@ -24,6 +24,10 @@ import importlib
 _LAZY = {
     "ObsServer": "ape_x_dqn_tpu.obs.exporter",
     "LineageTracker": "ape_x_dqn_tpu.obs.lineage",
+    "TraceSpanLog": "ape_x_dqn_tpu.obs.lineage",
+    "FleetAggregator": "ape_x_dqn_tpu.obs.fleet",
+    "SloEngine": "ape_x_dqn_tpu.obs.fleet",
+    "SloRule": "ape_x_dqn_tpu.obs.fleet",
     "FlightRecorder": "ape_x_dqn_tpu.obs.recorder",
     "write_postmortem": "ape_x_dqn_tpu.obs.recorder",
     "Counter": "ape_x_dqn_tpu.obs.registry",

@@ -48,6 +48,66 @@ from ape_x_dqn_tpu.utils.metrics import LatencyHistogram
 SPAN_ORDER = ("t_act", "t_ingest", "t_first_sample", "t_trained")
 
 
+class TraceSpanLog:
+    """Bounded per-process log of CROSS-TIER trace spans.
+
+    PR 4's lineage follows an experience through ONE process's hand-offs;
+    the RPC planes (replay service, central inference, serving net) cross
+    process boundaries where the trace used to die.  Every participant —
+    RPC client, shard server, serving front end, worker — records its hop
+    here: ``{trace_id, hop, pid, t0_s, t1_s, dur_ms, ...}`` with
+    CLOCK_MONOTONIC stamps (comparable across processes on one host —
+    the transport's documented clock discipline; cross-host spans are
+    skew-bounded like lineage's).  The fleet aggregator (obs/fleet.py)
+    collects each process's recent spans off /varz or the shard ``stats``
+    RPC and groups them by trace id into end-to-end timelines.
+
+    Thread-safe; stdlib-only by design (shard servers and worker children
+    construct one before jax exists)."""
+
+    def __init__(self, depth: int = 128, emit=None, recorder=None):
+        self._spans: deque = deque(maxlen=int(depth))
+        self._emit = emit          # callable(name, **fields) — JSONL events
+        self._recorder = recorder  # FlightRecorder mirror (shm-ring reach)
+        self._lock = threading.Lock()
+        self.recorded = 0
+
+    def record(self, trace_id: int, hop: str, t0: float,
+               t1: Optional[float] = None, **meta) -> Optional[dict]:
+        """One completed hop span; no-op (None) when ``trace_id`` is 0 —
+        call sites stay unconditional, the sample gate lives here."""
+        if not trace_id:
+            return None
+        import os as _os
+
+        t1 = float(t1 if t1 is not None else time.monotonic())
+        span = {
+            "trace_id": int(trace_id), "hop": hop, "pid": _os.getpid(),
+            "t0_s": round(float(t0), 6), "t1_s": round(t1, 6),
+            "dur_ms": round((t1 - float(t0)) * 1e3, 3), **meta,
+        }
+        with self._lock:
+            self._spans.append(span)
+            self.recorded += 1
+        if self._recorder is not None:
+            try:
+                self._recorder.record("trace_span", **span)
+            except Exception:  # noqa: BLE001 — tracing must not kill a run
+                pass
+        if self._emit is not None:
+            try:
+                self._emit("trace_span", **span)
+            except Exception:  # noqa: BLE001 — tracing must not kill a run
+                pass
+        return span
+
+    def snapshot(self) -> dict:
+        """The ``trace_spans`` /varz shape: recent spans + the cumulative
+        count (the aggregator's dedup key is (pid, trace_id, hop, t0_s))."""
+        with self._lock:
+            return {"recorded": self.recorded, "spans": list(self._spans)}
+
+
 class LineageTracker:
     def __init__(self, capacity: int, emit=None, max_open_traces: int = 512,
                  keep_completed: int = 16):
@@ -159,6 +219,21 @@ class LineageTracker:
                 done.append(rec)
         for rec in done:
             self._complete(rec)
+
+    def trace_ids_for(self, indices) -> List[int]:
+        """Open trace ids among these replay slots (deduped, first-seen
+        order) — how the learner tags a sample / priority-write-back RPC
+        span with the trace of an experience it touched."""
+        idx = np.asarray(indices, np.int64)
+        if idx.size == 0 or not self._traced[idx].any():
+            return []
+        out: List[int] = []
+        with self._lock:
+            for s in idx[self._traced[idx]]:
+                tid = self._slot_trace.get(int(s))
+                if tid is not None and tid not in out:
+                    out.append(tid)
+        return out
 
     # -- internals ---------------------------------------------------------
 

@@ -47,6 +47,16 @@ class Counter:
             self._value += n
         self._rate.add(n)
 
+    def merge(self, other: "Counter") -> None:
+        """Fold another counter in: totals add, rate windows interleave
+        (the rollup discipline — associative/commutative like every
+        merge in this module)."""
+        with other._lock:
+            value = other._value
+        with self._lock:
+            self._value += value
+        self._rate.merge(other._rate)
+
     @property
     def value(self) -> float:
         with self._lock:
@@ -89,6 +99,15 @@ class Gauge:
     def snapshot(self):
         return self.value
 
+    def merge(self, other: "Gauge") -> None:
+        """Fold another gauge in: last-write-wins has no cross-process
+        order, so the merge takes the MAX (the conservative rollup for
+        occupancy/backlog-style gauges; max is associative/commutative
+        where picking either side is not).  Computed gauges merge by
+        value at merge time."""
+        self._value = max(self.value, other.value)
+        self._fn = None   # the merged value is a plain scalar now
+
 
 class Histogram:
     """Log-bucketed distribution (``utils.metrics.LatencyHistogram``):
@@ -112,6 +131,12 @@ class Histogram:
 
     def percentile(self, p: float) -> float:
         return self._hist.percentile(p)
+
+    def merge(self, other: "Histogram") -> None:
+        """Bucket-wise fold (``LatencyHistogram.merge`` — layouts must
+        match or it raises; silent misalignment would corrupt
+        percentiles)."""
+        self._hist.merge(other._hist)
 
     def snapshot(self):
         out = self._hist.summary()
@@ -268,6 +293,25 @@ class Health:
             self._age_fns[name] = age_fn
             if stale_after_s is not None:
                 self._stale[name] = float(stale_after_s)
+
+    def merge(self, other: "Health") -> None:
+        """Fold another Health in: component sets union; a component both
+        sides track keeps its FRESHEST beat (max timestamp = min age —
+        associative/commutative, so fold order never changes status()),
+        and the tighter per-component staleness bound wins.  Age
+        functions ride through where this side has none (a merged view
+        keeps watching live sources)."""
+        with other._lock:
+            beats = dict(other._beats)
+            age_fns = dict(other._age_fns)
+            stale = dict(other._stale)
+        with self._lock:
+            for name, t in beats.items():
+                self._beats[name] = max(self._beats.get(name, t), t)
+            for name, fn in age_fns.items():
+                self._age_fns.setdefault(name, fn)
+            for name, bound in stale.items():
+                self._stale[name] = min(self._stale.get(name, bound), bound)
 
     def status(self) -> dict:
         now = time.monotonic()

@@ -96,6 +96,7 @@ from ape_x_dqn_tpu.runtime.net import (
     F_RERR,
     F_RREP,
     F_RREQ,
+    HELLO_FLAG_TRACE,
     RSVC_ACK_MAGIC,
     RSVC_MAGIC,
     Backoff,
@@ -103,12 +104,19 @@ from ape_x_dqn_tpu.runtime.net import (
     decode_xpb_payload,
     encode_xpb_payload,
     frame_bytes,
+    split_trace,
+    wrap_trace,
 )
+from ape_x_dqn_tpu.obs.lineage import TraceSpanLog
 from ape_x_dqn_tpu.runtime.shm_ring import XP, decode_chunk, encode_chunk_parts
+from ape_x_dqn_tpu.utils.metrics import LatencyHistogram
 
 RSVC_VERSION = 1
-# magic, version, client_id, shard_id, incarnation, token, codec
-RSVC_HELLO = struct.Struct("<4sIqqqqB7x")
+# magic, version, client_id, shard_id, incarnation, token, codec, flags
+# (flags was a pad byte — a pre-flags client packs 0 there, so the old
+# hello reads as flags=0 and the wire stays bit-identical; bit 0 =
+# HELLO_FLAG_TRACE negotiates the per-request trace prefix).
+RSVC_HELLO = struct.Struct("<4sIqqqqBB6x")
 # magic, version, shard_id, incarnation, capacity, count
 RSVC_ACK = struct.Struct("<4sIqqqq")
 
@@ -222,8 +230,8 @@ class _Transition:
 
 
 class _RConn:
-    __slots__ = ("sock", "parser", "hello", "client_id", "codec", "outbox",
-                 "out_off", "out_seq", "bytes_in", "bytes_out")
+    __slots__ = ("sock", "parser", "hello", "client_id", "codec", "flags",
+                 "outbox", "out_off", "out_seq", "bytes_in", "bytes_out")
 
     def __init__(self, sock: socket.socket, max_frame: int):
         self.sock = sock
@@ -231,6 +239,7 @@ class _RConn:
         self.hello = bytearray()
         self.client_id: Optional[int] = None   # None until the ack went out
         self.codec = CODEC_OFF
+        self.flags = 0
         self.outbox: collections.deque = collections.deque()
         self.out_off = 0
         self.out_seq = 0
@@ -314,6 +323,12 @@ class ReplayShardServer:
         self.reply_full_waits = 0   # sends that hit a full kernel buffer
         self.reply_zlib = 0         # sample replies shipped compressed
         self.reply_raw = 0          # sample replies shipped raw
+        # Per-request service latency (request verified → reply enqueued)
+        # on the shared log-bucket layout, so the fleet aggregator can
+        # merge shard histograms bucket-wise across the fleet; plus the
+        # cross-tier span log (a traced request's server-side hop).
+        self.op_ms = LatencyHistogram(min_s=1e-5, max_s=120.0)
+        self.spans = TraceSpanLog(depth=64)
         self._auto_on = False
         self._auto_idle = 0
         self._auto_fw_mark = 0
@@ -493,12 +508,12 @@ class ReplayShardServer:
         from before a respawn pinning the OLD incarnation against the new
         process) is rejected BEFORE any framing state exists."""
         try:
-            magic, version, client_id, shard_id, incarnation, token, codec \
-                = RSVC_HELLO.unpack(bytes(conn.hello))
+            (magic, version, client_id, shard_id, incarnation, token, codec,
+             flags) = RSVC_HELLO.unpack(bytes(conn.hello))
         except struct.error:
             magic = b""
             version = client_id = shard_id = incarnation = token = -1
-            codec = 255
+            codec, flags = 255, 0
         ok = (magic == RSVC_MAGIC and version == RSVC_VERSION
               and shard_id == self.shard_id and token == self.token)
         stale = ok and incarnation not in (-1, self.incarnation)
@@ -516,6 +531,7 @@ class ReplayShardServer:
             return False
         conn.client_id = int(client_id)
         conn.codec = int(codec)
+        conn.flags = int(flags)
         ack = RSVC_ACK.pack(
             RSVC_ACK_MAGIC, RSVC_VERSION, self.shard_id, self.incarnation,
             int(self.replay.capacity), int(self.replay.total_added),
@@ -542,6 +558,17 @@ class ReplayShardServer:
     # -- request execution -------------------------------------------------
 
     def _handle(self, conn: _RConn, payload: bytes) -> None:
+        t_req = time.monotonic()
+        trace_id = 0
+        if conn.flags & HELLO_FLAG_TRACE:
+            # Trace-negotiated connection: every request leads with its
+            # i64 trace id (0 = unsampled) — the version-gated envelope.
+            try:
+                trace_id, payload = split_trace(payload)
+            except ValueError as e:
+                self.errors += 1
+                self._reply_err(conn, 0, RE_BAD_REQUEST, str(e))
+                return
         if len(payload) < _RPC.size:
             self.errors += 1
             self._reply_err(conn, 0, RE_BAD_REQUEST, "short rpc head")
@@ -587,6 +614,11 @@ class ReplayShardServer:
             self.errors += 1
             self._reply_err(conn, req_id, RE_INTERNAL,
                             f"{type(e).__name__}: {e}")
+        # Service latency (request verified → reply enqueued) always;
+        # the cross-tier span only when the request carried a trace id.
+        self.op_ms.record(time.monotonic() - t_req)
+        self.spans.record(trace_id, f"rsvc.{_OP_NAMES.get(op, str(op))}",
+                          t_req, shard=self.shard_id, op=int(op))
 
     def _op_add(self, conn: _RConn, req_id: int, body) -> None:
         self.ops["add"] += 1
@@ -776,6 +808,13 @@ class ReplayShardServer:
             "size": int(self.replay.size()),
             "total_added": int(self.replay.total_added),
             "saves": self.saves,
+            # Fleet-rollup surfaces (obs/fleet.py): the service-latency
+            # histogram ships summary + raw buckets so the aggregator can
+            # merge shards bucket-wise; recent cross-tier spans ride the
+            # same stats RPC (the shard's half of an end-to-end trace).
+            "op_ms": {**self.op_ms.summary(),
+                      "buckets": self.op_ms.buckets()},
+            "trace_spans": self.spans.snapshot(),
         }
         if self._ckpt is not None:
             out["ckpt"] = self._ckpt.stats()
@@ -804,6 +843,7 @@ class ShardClient:
 
     def __init__(self, shard_id: int, host: str, port: int, *, token: int,
                  client_id: int, incarnation: int = -1, codec: str = "zlib",
+                 trace: bool = False,
                  connect_timeout_s: float = 1.0, io_timeout_s: float = 5.0,
                  max_frame: int = _DEFAULT_MAX_FRAME, seed: int = 0,
                  on_incarnation: Optional[Callable[[int, int], None]] = None):
@@ -817,6 +857,10 @@ class ShardClient:
         self.incarnation = int(incarnation)   # registry view; -1 = unknown
         self.codec = codec
         self._codec_id = _CODEC_IDS[codec]
+        # Cross-tier tracing: negotiated at the hello (flags bit); with it
+        # every request leads with an i64 trace id.  Off = the pre-flags
+        # wire, byte for byte.
+        self.trace = bool(trace)
         self._connect_timeout = float(connect_timeout_s)
         self._io_timeout = float(io_timeout_s)
         self._max_frame = int(max_frame)
@@ -867,6 +911,7 @@ class ShardClient:
             sock.sendall(RSVC_HELLO.pack(
                 RSVC_MAGIC, RSVC_VERSION, self.client_id, self.shard_id,
                 self.incarnation, self.token, self._codec_id,
+                HELLO_FLAG_TRACE if self.trace else 0,
             ))
             sock.settimeout(
                 max(0.05, min(self._io_timeout,
@@ -911,15 +956,20 @@ class ShardClient:
 
     def request(self, op: int, body: bytes = b"",
                 timeout: float = 10.0,
-                req_id: Optional[int] = None) -> Tuple[int, bytes]:
+                req_id: Optional[int] = None,
+                trace_id: int = 0) -> Tuple[int, bytes]:
         """(flags, reply payload past the head) for one RPC, across
         reconnects and whole-request retries.  Raises
         :class:`ReplayRpcError` on a typed refusal (the request WAS
         answered) and :class:`ReplayShardUnavailable` when the deadline
-        expires unanswered."""
+        expires unanswered.  ``trace_id`` rides the trace prefix on a
+        trace-negotiated connection (retries re-send it unchanged — the
+        whole retry span is one logical traced request)."""
         deadline = time.monotonic() + timeout
         rid = self.next_req_id() if req_id is None else int(req_id)
         payload = _RPC.pack(rid, int(op)) + body
+        if self.trace:
+            payload = wrap_trace(trace_id, payload)
         first = True
         while time.monotonic() < deadline:
             if not self._ensure_connected(deadline):
@@ -1044,6 +1094,7 @@ class ShardedReplayClient:
 
     def __init__(self, shards: Sequence[dict], *, token: int,
                  codec: str = "zlib", dedup: bool = True,
+                 trace: bool = False,
                  request_timeout_s: float = 10.0,
                  probe_interval_s: float = 0.5,
                  client_id: Optional[int] = None,
@@ -1074,11 +1125,18 @@ class ShardedReplayClient:
         self.client_id = int(client_id)
         self._clients: List[ShardClient] = []
         self._locks: List[threading.Lock] = []
+        # Cross-tier tracing (negotiated per connection): the learner's
+        # RPC hops join the experience lineage — client-side spans land
+        # here, the shard-side halves ride each shard's stats RPC.
+        self.trace = bool(trace)
+        self.spans = TraceSpanLog(depth=128)
+        self._last_sample: Optional[Tuple[int, float, float]] = None
         for s in shards:
             self._clients.append(ShardClient(
                 int(s["id"]), s["host"], int(s["port"]), token=int(token),
                 client_id=self.client_id,
                 incarnation=int(s.get("incarnation", -1)), codec=codec,
+                trace=self.trace,
                 io_timeout_s=min(5.0, request_timeout_s),
                 seed=seed ^ self.client_id,
             ))
@@ -1246,10 +1304,12 @@ class ShardedReplayClient:
 
     # -- replay surface ----------------------------------------------------
 
-    def add(self, priorities: np.ndarray, batch) -> np.ndarray:
+    def add(self, priorities: np.ndarray, batch,
+            trace_id: int = 0) -> np.ndarray:
         """Route one chunk to a healthy shard; returns GLOBAL slot
         indices.  Re-routes to a survivor when the chosen shard dies
-        mid-request."""
+        mid-request.  ``trace_id`` (a traced chunk's lineage id) rides
+        the RPC's trace prefix and stamps the client-side hop span."""
         arrays = {
             "prio": np.asarray(priorities, np.float64),
             "obs": np.asarray(batch.obs),
@@ -1258,6 +1318,7 @@ class ShardedReplayClient:
             "discount": np.asarray(batch.discount),
             "next_obs": np.asarray(batch.next_obs),
         }
+        trace_id = trace_id if self.trace else 0
         body = encode_body(arrays, codec=self._codec_id, dedup=self._dedup)
         candidates = self._healthy() or list(range(self.num_shards))
         self._add_rr += 1
@@ -1266,10 +1327,13 @@ class ShardedReplayClient:
         last_err: Optional[ReplayShardUnavailable] = None
         for pos, sid in enumerate(order):
             try:
+                t0 = time.monotonic()
                 with self._locks[sid]:
                     _flags, rep = self._clients[sid].request(
-                        OP_ADD, body, timeout=self._timeout
+                        OP_ADD, body, timeout=self._timeout,
+                        trace_id=trace_id,
                     )
+                self.spans.record(trace_id, "rsvc.add.client", t0, shard=sid)
                 idx = decode_body(rep)["idx"]
                 self.adds += 1
                 if pos:
@@ -1316,12 +1380,17 @@ class ShardedReplayClient:
         for pos, sid in enumerate(map(int, order)):
             seed = int(rng.integers(0, 2 ** 63 - 1))
             try:
+                t0 = time.monotonic()
                 with self._locks[sid]:
                     _flags, rep = self._clients[sid].request(
                         OP_SAMPLE,
                         _SAMPLE_REQ.pack(int(batch_size), float(beta), seed),
                         timeout=self._timeout,
                     )
+                # Whether this sample touched a traced experience is only
+                # knowable AFTER lineage sees the slot indices — park the
+                # hop and let tag_sample_span stamp it post-hoc.
+                self._last_sample = (sid, t0, time.monotonic())
             except ReplayShardUnavailable as e:
                 last_err = e
                 self._mark_down(sid, f"sample: {e}")
@@ -1362,11 +1431,25 @@ class ShardedReplayClient:
             "no healthy replay shard", op="sample"
         )
 
+    def tag_sample_span(self, trace_id: int) -> None:
+        """Stamp the newest sample RPC's client hop with a trace id (the
+        learner calls this after lineage identifies a traced slot in the
+        returned batch) — closing the sample leg of the e2e timeline."""
+        parked, self._last_sample = self._last_sample, None
+        if parked is not None and self.trace:
+            sid, t0, t1 = parked
+            self.spans.record(trace_id, "rsvc.sample.client", t0, t1,
+                              shard=sid)
+
     def update_priorities(self, indices: np.ndarray,
-                          priorities: np.ndarray) -> None:
+                          priorities: np.ndarray,
+                          trace_id: int = 0) -> None:
         """Split by slot range; a down shard's slice buffers
         last-write-wins and flushes on recovery — the learner never
-        blocks on a dead shard's priorities."""
+        blocks on a dead shard's priorities.  ``trace_id`` marks the
+        write-back of a traced experience (the timeline's final RPC
+        hop)."""
+        trace_id = trace_id if self.trace else 0
         indices = np.asarray(indices, np.int64)
         priorities = np.asarray(priorities, np.float64)
         if indices.size == 0:
@@ -1382,13 +1465,17 @@ class ShardedReplayClient:
                 self._buffer_writeback(sid, idx, prio)
                 continue
             try:
+                t0 = time.monotonic()
                 with self._locks[sid]:
                     self._clients[sid].request(
                         OP_UPDATE,
                         encode_body({"idx": idx, "prio": prio},
                                     codec=self._codec_id, dedup=False),
                         timeout=self._timeout,
+                        trace_id=trace_id,
                     )
+                self.spans.record(trace_id, "rsvc.update.client", t0,
+                                  shard=sid)
                 self.updates += 1
             except ReplayShardUnavailable as e:
                 self._buffer_writeback(sid, idx, prio)

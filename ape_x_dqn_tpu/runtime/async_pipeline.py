@@ -206,10 +206,19 @@ class _ActorWorker:
         self._quantum = quantum or comps.cfg.actor.flush_every
         # Where chunks go: the host replay by default, or any
         # (priorities, transitions) callable (the fused learner's staging
-        # sink in device-replay mode).
-        self._sink = sink if sink is not None else (
-            lambda prio, trans: comps.replay.add(prio, trans)
-        )
+        # sink in device-replay mode).  A remote replay's add is an RPC —
+        # hand it the chunk's trace id so the hop joins the lineage
+        # timeline (takes_trace marks the wider signature).
+        if sink is not None:
+            self._sink = sink
+        elif getattr(comps.replay, "remote", False):
+            def _traced_sink(prio, trans, trace_id=0):
+                return comps.replay.add(prio, trans, trace_id=trace_id)
+
+            _traced_sink.takes_trace = True
+            self._sink = _traced_sink
+        else:
+            self._sink = lambda prio, trans: comps.replay.add(prio, trans)
         self.restarts = 0
         # Fleet seed base: nonzero under multi-host SPMD so each host's
         # actors explore distinct streams while the MODEL seed (cfg.seed)
@@ -292,14 +301,18 @@ class _ActorWorker:
                 selector=selector,
             )
             for chunk in chunks:
-                idx = self._sink(chunk.priorities, chunk.transitions)
+                trace_id = 0
+                if self._lineage is not None and self._trace_rate \
+                        and self._trace_rng.random() < self._trace_rate:
+                    trace_id = self._trace_rng.getrandbits(63) or 1
+                if getattr(self._sink, "takes_trace", False):
+                    idx = self._sink(chunk.priorities, chunk.transitions,
+                                     trace_id)
+                else:
+                    idx = self._sink(chunk.priorities, chunk.transitions)
                 self.actor_steps += chunk.actor_steps
                 self._fps.add(chunk.actor_steps)
                 if self._lineage is not None and idx is not None:
-                    trace_id = 0
-                    if self._trace_rate \
-                            and self._trace_rng.random() < self._trace_rate:
-                        trace_id = self._trace_rng.getrandbits(63) or 1
                     self._lineage.on_ingest(idx, trace_id=trace_id)
             if stats:
                 with self._ep_lock:
@@ -598,11 +611,23 @@ class AsyncPipeline:
                 self.store.publish(
                     self._params_host(self.comps.state.params)
                 )
+            if sink is not None:
+                proc_sink = sink
+            elif self._remote_replay is not None:
+                # Remote replay: the add RPC carries the chunk's wire-
+                # envelope trace id, so a traced experience's first RPC
+                # hop lands on the cross-tier timeline.
+                def proc_sink(prio, trans, trace_id=0):
+                    return self.comps.replay.add(prio, trans,
+                                                 trace_id=trace_id)
+
+                proc_sink.takes_trace = True
+            else:
+                def proc_sink(prio, trans):
+                    return self.comps.replay.add(prio, trans)
             self.worker = ProcessActorWorker(
                 pool,
-                sink if sink is not None else (
-                    lambda prio, trans: self.comps.replay.add(prio, trans)
-                ),
+                proc_sink,
                 logger=self.logger,
                 fps=self._fps,
                 stop_event=self.stop_event,
@@ -655,6 +680,12 @@ class AsyncPipeline:
                 "inference", self._inference_section
             )
         self.obs_registry.register_provider("learner", self._learner_varz)
+        # Cross-tier trace spans (obs/lineage.TraceSpanLog): everything
+        # THIS process (and its swept workers) recorded, in one place for
+        # the fleet aggregator to collect into e2e timelines.
+        self.obs_registry.register_provider(
+            "trace_spans", self._trace_spans
+        )
         self.obs_registry.register_provider(
             "stage_us", self.timers.us_per_call
         )
@@ -860,6 +891,7 @@ class AsyncPipeline:
             host, port, wid=0, attempt=incarnation, token=token,
             codec=a.inference_codec, dedup=a.inference_dedup,
             inflight=a.inference_inflight, seed=self.cfg.seed,
+            trace=self.cfg.obs.trace_sample_rate > 0,
         )
         fallback = None
         if a.inference_fallback == "local":
@@ -876,11 +908,40 @@ class AsyncPipeline:
         sel = CentralSelector(
             client, np.asarray(fleet._epsilons), fleet.envs.num_actions,
             seed=self.cfg.seed + 77_000 + incarnation,
-            timeout_s=a.inference_timeout_s, fallback=fallback,
+            timeout_s=a.inference_timeout_s,
+            trace_sample_rate=self.cfg.obs.trace_sample_rate,
+            fallback=fallback,
             should_stop=self.stop_event.is_set,
         )
         self._central_selectors = [sel]   # latest incarnation wins
         return sel
+
+    def _trace_spans(self) -> dict:
+        """The ``trace_spans`` /varz provider: cross-tier spans from
+        every log this process owns — the remote-replay client's RPC
+        hops, the in-process serving tier's server hops, thread-mode
+        inference clients — plus the live workers' shm event rings
+        (worker-pid ``act`` spans and central-inference client spans,
+        swept without any extra IPC)."""
+        spans: list = []
+        recorded = 0
+        logs = []
+        if self._remote_replay is not None:
+            logs.append(self._remote_replay.spans)
+        if self._central_net is not None:
+            logs.append(self._central_net.spans)
+        for sel in self._central_selectors:
+            logs.append(sel.client.spans)
+        for log in logs:
+            snap = log.snapshot()
+            recorded += snap["recorded"]
+            spans.extend(snap["spans"])
+        pool = getattr(self.worker, "pool", None)
+        if pool is not None and hasattr(pool, "trace_events"):
+            worker_spans = pool.trace_events()
+            recorded += len(worker_spans)
+            spans.extend(worker_spans)
+        return {"recorded": recorded, "spans": spans[-256:]}
 
     def _inference_section(self) -> dict:
         """The obs ``inference`` section (docs/METRICS.md "Inference
@@ -1045,7 +1106,16 @@ class AsyncPipeline:
                 prio = np.concatenate(
                     [self._priorities_host(p) for _, p in pending]
                 )
-            self.comps.replay.update_priorities(idx, prio)
+            if self._remote_replay is not None:
+                # Remote replay: a traced experience among these slots
+                # stamps the write-back RPC — the timeline's final hop.
+                tids = (self._lineage.trace_ids_for(idx)
+                        if self._lineage is not None else [])
+                self.comps.replay.update_priorities(
+                    idx, prio, trace_id=tids[0] if tids else 0
+                )
+            else:
+                self.comps.replay.update_priorities(idx, prio)
         if self._lineage is not None:
             # The write-back forced the batched steps' device work —
             # their slots are now TRAINED.
@@ -1126,6 +1196,13 @@ class AsyncPipeline:
                         host_indices, batch = queue.get()
                     if self._lineage is not None:
                         self._lineage.on_sample(host_indices)
+                        if self._remote_replay is not None:
+                            # A traced slot in this batch stamps the
+                            # parked sample-RPC span post hoc (whether a
+                            # sample hits a trace is only knowable here).
+                            tids = self._lineage.trace_ids_for(host_indices)
+                            if tids:
+                                self._remote_replay.tag_sample_span(tids[0])
                     with self.timers.stage("step_dispatch"):
                         state, metrics = self.train_step(state, batch)
                     # Keep the live state visible on self so a mid-run

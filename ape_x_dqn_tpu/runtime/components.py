@@ -279,6 +279,9 @@ def build_components(cfg: ApexConfig) -> Components:
             cfg.replay.service_endpoints,
             codec=cfg.replay.service_codec,
             dedup=cfg.replay.service_dedup,
+            # Cross-tier tracing follows the lineage sample rate: a
+            # traced chunk's add/sample/write-back RPCs carry its id.
+            trace=cfg.obs.trace_sample_rate > 0,
             request_timeout_s=cfg.replay.service_request_timeout_s,
             probe_interval_s=cfg.replay.service_probe_interval_s,
             seed=cfg.seed,

@@ -154,6 +154,32 @@ _AUTO_OFF_FLUSHES = 256   # net_codec=auto: raw again after this many
 SERVE_MAGIC = b"APXQ"
 SERVE_VERSION = 1
 SERVE_VERSION_EXT = 2
+# Hello feature flags (the former pad byte right behind the codec in the
+# v2 extension structs — every pre-flags hello packed 0 there, so an old
+# client reads as flags=0 and the wire stays bit-identical).  Bit 0
+# negotiates CROSS-TIER TRACING: on a trace-negotiated connection every
+# REQUEST-kind payload (F_SREQ / F_IREQ / F_RREQ) begins with one
+# little-endian i64 trace id (0 = this request unsampled), so a lineage
+# trace survives the RPC hop instead of dying at the socket.  Replies
+# are unchanged — the requester keys its span on its own req_id.
+HELLO_FLAG_TRACE = 1
+_TRACE_ID = struct.Struct("<q")
+
+
+def wrap_trace(trace_id: int, payload) -> bytes:
+    """Prefix one request payload with its trace id (trace-negotiated
+    connections only — the flags-off wire never carries this)."""
+    return _TRACE_ID.pack(int(trace_id)) + _as_bytes(payload)
+
+
+def split_trace(payload):
+    """(trace_id, rest) of a trace-prefixed request payload.  Raises
+    ValueError on a payload too short to carry the prefix — the caller
+    replies typed (the crc already proved the bytes arrived intact)."""
+    if len(payload) < _TRACE_ID.size:
+        raise ValueError("request shorter than its trace prefix")
+    (tid,) = _TRACE_ID.unpack_from(payload, 0)
+    return int(tid), memoryview(payload)[_TRACE_ID.size:]
 # Replay-service hello magics (replay/service.py speaks them; declared
 # HERE because net.py is the registry of every wire-plane magic — one
 # place to see that no two protocols share a handshake byte pattern.
@@ -162,7 +188,9 @@ SERVE_VERSION_EXT = 2
 RSVC_MAGIC = b"APXV"
 RSVC_ACK_MAGIC = b"APXA"
 SERVE_HELLO = struct.Struct("<4sI")
-SERVE_HELLO_EXT = struct.Struct("<qqqB7x")   # wid, attempt, token, codec
+# wid, attempt, token, codec, flags (HELLO_FLAG_*; was pad — old hellos
+# read as flags=0, the bit-identical-wire gate for tracing).
+SERVE_HELLO_EXT = struct.Struct("<qqqBB6x")
 # Request: u64 req_id | u8 ndim | u8 dtype (0=uint8) | 6x pad | u32 dims…
 _SREQ_HEAD = struct.Struct("<QBB6x")
 _SREQ_DIM = struct.Struct("<I")
@@ -208,11 +236,14 @@ def serve_hello_bytes() -> bytes:
 
 
 def serve_hello_ext_bytes(wid: int, attempt: int, token: int,
-                          codec: int = CODEC_OFF) -> bytes:
+                          codec: int = CODEC_OFF,
+                          flags: int = 0) -> bytes:
     """The v2 fleet-internal hello (central inference): the v1 header
-    with the extension struct right behind it."""
+    with the extension struct right behind it.  ``flags=0`` keeps the
+    pre-flags bytes exactly."""
     return SERVE_HELLO.pack(SERVE_MAGIC, SERVE_VERSION_EXT) + \
-        SERVE_HELLO_EXT.pack(int(wid), int(attempt), int(token), int(codec))
+        SERVE_HELLO_EXT.pack(int(wid), int(attempt), int(token), int(codec),
+                             int(flags))
 
 
 def parse_serve_hello(buf: bytes) -> bool:
@@ -232,13 +263,14 @@ def parse_serve_hello_ext(buf: bytes) -> Optional[dict]:
     if len(buf) != SERVE_HELLO_EXT.size:
         return None
     try:
-        wid, attempt, token, codec = SERVE_HELLO_EXT.unpack(buf)
+        wid, attempt, token, codec, flags = SERVE_HELLO_EXT.unpack(buf)
     except struct.error:
         return None
     if codec not in (CODEC_OFF, CODEC_ZLIB):
         return None
     return {"wid": int(wid), "attempt": int(attempt),
-            "token": int(token), "codec": int(codec)}
+            "token": int(token), "codec": int(codec),
+            "flags": int(flags)}
 
 
 def encode_request(req_id: int, obs) -> bytes:

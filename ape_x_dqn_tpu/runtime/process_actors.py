@@ -459,6 +459,11 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                 dedup=cfg.actor.inference_dedup,
                 inflight=cfg.actor.inference_inflight,
                 seed=cfg.seed + worker_id,
+                # Cross-tier tracing at the lineage sample rate: spans
+                # mirror into this worker's recorder → shm event ring,
+                # where the parent's trace sweep reads them.
+                trace=trace_rate > 0,
+                span_recorder=recorder,
             )
             fallback_fn = None
             if cfg.actor.inference_fallback == "local" and source is not None:
@@ -483,6 +488,7 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                 fleet.envs.num_actions,
                 seed=cfg.seed + 77_000 + worker_id + 100_000 * attempt,
                 timeout_s=cfg.actor.inference_timeout_s,
+                trace_sample_rate=trace_rate,
                 fallback=fallback_fn,
                 should_stop=stop_evt.is_set,
             )
@@ -1249,6 +1255,38 @@ class ProcessActorPool:
             ), meta)
         return (prio, self._NStepTransition(**arrays), meta)
 
+    def trace_events(self, max_per_worker: int = 32) -> List[dict]:
+        """Cross-tier trace spans recorded by LIVE workers, swept off
+        their shm event rings (the flight recorder mirrors every
+        ``trace_chunk`` / ``trace_span`` event there, so worker-side
+        spans are readable without any new plumbing — and survive a
+        SIGKILL exactly like the rest of the block).  ``trace_chunk``
+        (the actor's flush of a traced chunk) is lifted into a
+        zero-duration ``act`` span: the hop that pins the WORKER's pid
+        onto the timeline."""
+        spans: List[dict] = []
+        for wid, blk in list(self._stats_blocks.items()):
+            try:
+                events, _torn = blk.recent_events(max_per_worker)
+                pid = blk.pid
+            except Exception:  # noqa: BLE001 — a dying block reads as no spans, never a sweep crash
+                continue
+            for ev in events:
+                tid = ev.get("trace_id")
+                if not tid:
+                    continue
+                if ev.get("kind") == "trace_chunk":
+                    t = float(ev.get("t", 0.0))
+                    spans.append({
+                        "trace_id": int(tid), "hop": "act", "pid": pid,
+                        "t0_s": t, "t1_s": t, "dur_ms": 0.0, "wid": wid,
+                    })
+                elif ev.get("kind") == "trace_span":
+                    spans.append(
+                        {k: v for k, v in ev.items() if k not in ("kind",)}
+                    )
+        return spans
+
     def transport_stats(self) -> dict:
         """Experience-transport metrics snapshot: ingest bytes/s, chunk
         latency percentiles, ring-full backpressure events (live rings plus
@@ -1409,8 +1447,15 @@ class ProcessActorWorker:
             self.pool.supervise()
             items = self.pool.poll(max_items=64, timeout=0.05,
                                    with_meta=True)
+            sink_trace = getattr(self._sink, "takes_trace", False)
             for prio, trans, meta in items:
-                idx = self._sink(prio, trans)
+                if sink_trace:
+                    # Remote-replay sink: the chunk's wire-envelope trace
+                    # id rides the add RPC (the cross-tier timeline's
+                    # wire → shard hop).
+                    idx = self._sink(prio, trans, meta["trace_id"])
+                else:
+                    idx = self._sink(prio, trans)
                 if self._fps is not None:
                     self._fps.add(len(prio))
                 if self._lineage is not None and idx is not None:

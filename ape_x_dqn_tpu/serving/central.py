@@ -46,6 +46,7 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from ape_x_dqn_tpu.obs.lineage import TraceSpanLog
 from ape_x_dqn_tpu.runtime.net import (
     CODEC_OFF,
     CODEC_ZLIB,
@@ -53,6 +54,7 @@ from ape_x_dqn_tpu.runtime.net import (
     E_OVERLOADED,
     F_IREP,
     F_SERR,
+    HELLO_FLAG_TRACE,
     Backoff,
     FrameParser,
     decode_error,
@@ -60,6 +62,7 @@ from ape_x_dqn_tpu.runtime.net import (
     encode_inference_request,
     frame_bytes,
     serve_hello_ext_bytes,
+    wrap_trace,
 )
 from ape_x_dqn_tpu.runtime.net import F_IREQ as _F_IREQ
 from ape_x_dqn_tpu.utils.metrics import LatencyHistogram
@@ -89,9 +92,17 @@ class CentralInferenceClient:
                  attempt: int = 0, token: int = 0, codec: str = "off",
                  dedup: bool = True, inflight: int = 4,
                  connect_timeout_s: float = 2.0, io_timeout_s: float = 5.0,
-                 max_frame: int = 64 << 20, seed: int = 0):
+                 max_frame: int = 64 << 20, seed: int = 0,
+                 trace: bool = False, span_recorder=None):
         if codec not in _CODEC_IDS:
             raise ValueError(f"unknown inference codec: {codec}")
+        # Cross-tier tracing: negotiated via the v2 hello's flags byte;
+        # with it every F_IREQ leads with an i64 trace id and each
+        # verified group reply records a client-side hop span (mirrored
+        # into ``span_recorder`` — the worker's flight recorder — so the
+        # span survives a SIGKILL via the shm event ring).
+        self.trace = bool(trace)
+        self.spans = TraceSpanLog(depth=64, recorder=span_recorder)
         self.host = host
         self.port = int(port)
         self.wid = int(wid)
@@ -149,7 +160,8 @@ class CentralInferenceClient:
             )
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.sendall(serve_hello_ext_bytes(
-                self.wid, self.attempt, self.token, self._codec_id
+                self.wid, self.attempt, self.token, self._codec_id,
+                flags=HELLO_FLAG_TRACE if self.trace else 0,
             ))
             sock.settimeout(self._io_timeout)
         except OSError:
@@ -169,7 +181,7 @@ class CentralInferenceClient:
 
     def select(self, obs_batch, *, deadline: Optional[float] = None,
                should_stop: Optional[Callable[[], bool]] = None,
-               timeout_s: float = 30.0):
+               timeout_s: float = 30.0, trace_id: int = 0):
         """One fleet step's action selection: (int32 actions [N],
         float32 q [N, A], param_version).
 
@@ -200,7 +212,8 @@ class CentralInferenceClient:
             first_round = False
             t_round = time.monotonic()
             try:
-                got = self._round(obs, groups, deadline, should_stop)
+                got = self._round(obs, groups, deadline, should_stop,
+                                  trace_id)
             except (OSError, socket.timeout):
                 self._drop()
                 self._backoff.fail()
@@ -219,9 +232,11 @@ class CentralInferenceClient:
             f"(retries={self.retries}, reconnects={self.reconnects})"
         )
 
-    def _round(self, obs, groups, deadline, should_stop):
+    def _round(self, obs, groups, deadline, should_stop, trace_id=0):
         """Send every group, await every reply.  None forces a whole
         retry (after a drop/backoff where the transport faulted)."""
+        if not self.trace:
+            trace_id = 0
         pending: dict = {}
         t_send: dict = {}
         for lo, hi in groups:
@@ -231,6 +246,8 @@ class CentralInferenceClient:
             payload, st = encode_inference_request(
                 rid, sub, codec=self._codec_id, dedup=self._dedup
             )
+            if self.trace:
+                payload = wrap_trace(trace_id, payload)
             self._out_seq += 1
             buf = frame_bytes(_F_IREQ, self._out_seq, [payload])
             self._sock.sendall(buf)
@@ -295,6 +312,8 @@ class CentralInferenceClient:
                 self.replies += 1
                 self._backoff.reset()
                 self.rtt.record(time.monotonic() - t_send[rid])
+                self.spans.record(trace_id, "inf.select.client",
+                                  t_send[rid], rows=hi - lo, wid=self.wid)
                 continue
             if kind == F_SERR:
                 rid, code, msg = decode_error(payload)
@@ -424,6 +443,7 @@ class CentralSelector:
     def __init__(self, client: CentralInferenceClient, epsilons,
                  num_actions: int, *, seed: int = 0,
                  timeout_s: float = 30.0,
+                 trace_sample_rate: float = 0.0,
                  fallback: Optional[Callable] = None,
                  should_stop: Optional[Callable[[], bool]] = None):
         self.client = client
@@ -431,6 +451,13 @@ class CentralSelector:
         self.num_actions = int(num_actions)
         self._rng = np.random.default_rng(seed)
         self._timeout_s = float(timeout_s)
+        # Cross-tier trace sampling (obs.trace_sample_rate's inference
+        # twin): a sampled select stamps one 63-bit id shared by all its
+        # pipelined groups — the worker → replica timeline's key.
+        self._trace_rate = float(trace_sample_rate)
+        import random as _random
+
+        self._trace_rng = _random.Random((seed << 8) ^ 0x7A5)
         # Local-fallback seam (actor.inference_fallback=local): a
         # callable (obs, step) -> (actions, q, version) over CACHED
         # params — it applies its own ε in-graph (it IS the local path),
@@ -442,11 +469,16 @@ class CentralSelector:
 
     def select(self, obs, step: int):
         self.selects += 1
+        trace_id = 0
+        if self._trace_rate and self.client.trace \
+                and self._trace_rng.random() < self._trace_rate:
+            trace_id = self._trace_rng.getrandbits(63) or 1
         while True:
             try:
                 greedy, q, version = self.client.select(
                     obs, timeout_s=self._timeout_s,
                     should_stop=self._should_stop,
+                    trace_id=trace_id,
                 )
                 break
             except InferenceUnavailable:

@@ -45,6 +45,7 @@ import threading
 import time
 from typing import Optional
 
+from ape_x_dqn_tpu.obs.lineage import TraceSpanLog
 from ape_x_dqn_tpu.runtime.net import (
     CODEC_OFF,
     E_BAD_REQUEST,
@@ -56,6 +57,7 @@ from ape_x_dqn_tpu.runtime.net import (
     F_SERR,
     F_SREP,
     F_SREQ,
+    HELLO_FLAG_TRACE,
     SERVE_HELLO,
     SERVE_HELLO_EXT,
     SERVE_MAGIC,
@@ -74,6 +76,9 @@ from ape_x_dqn_tpu.runtime.net import (
     parse_serve_hello,
     parse_serve_hello_ext,
     serve_hello_bytes,
+    serve_hello_ext_bytes,
+    split_trace,
+    wrap_trace,
 )
 from ape_x_dqn_tpu.serving.batcher import (
     ServedAction,
@@ -92,7 +97,7 @@ class _NetConn:
     appends come from batcher callbacks under the server lock)."""
 
     __slots__ = ("sock", "parser", "hello", "hello_need", "hello_done",
-                 "wid", "codec", "outbox", "out_off", "out_seq",
+                 "wid", "codec", "flags", "outbox", "out_off", "out_seq",
                  "bytes_in", "bytes_out", "inflight")
 
     def __init__(self, sock: socket.socket, max_frame: int):
@@ -103,6 +108,7 @@ class _NetConn:
         self.hello_done = False
         self.wid: Optional[int] = None    # v2 hellos: the fleet worker id
         self.codec = CODEC_OFF            # negotiated obs-payload codec
+        self.flags = 0                    # v2 hello feature flags (trace)
         self.outbox: collections.deque = collections.deque()
         self.out_off = 0                  # send offset into outbox[0]
         self.out_seq = 0
@@ -164,6 +170,11 @@ class ServingNetServer:
         self.inference_rows = 0
         self.inference_replies = 0
         self._sources: dict = {}
+        # Cross-tier trace spans: a trace-negotiated connection's requests
+        # lead with an i64 trace id; the server records its hop (decode →
+        # reply queued) plus the batcher leg, and the fleet aggregator
+        # collects them off this process's /varz into e2e timelines.
+        self.spans = TraceSpanLog(depth=64)
         # Retired-connection byte history (a reconnecting client must not
         # take its traffic with it — the NetTransport._base discipline).
         self._bytes_in_closed = 0
@@ -329,6 +340,7 @@ class ServingNetServer:
             return False
         conn.wid = ext["wid"]
         conn.codec = ext["codec"]
+        conn.flags = ext["flags"]
         conn.hello_done = True
         return True
 
@@ -352,8 +364,11 @@ class ServingNetServer:
 
     def _handle_request(self, conn: _NetConn, payload: bytes) -> None:
         t0 = time.monotonic()
+        trace_id = 0
         try:
-            req_id, obs = decode_request(payload)
+            if conn.flags & HELLO_FLAG_TRACE:
+                trace_id, payload = split_trace(payload)
+            req_id, obs = decode_request(bytes(payload))
         except ValueError as e:
             self.errors += 1
             self._enqueue(conn, F_SERR, encode_error(0, E_BAD_REQUEST,
@@ -373,11 +388,12 @@ class ServingNetServer:
             return
         conn.inflight += 1
         fut.add_done_callback(
-            lambda f, c=conn, rid=req_id, t=t0: self._complete(c, rid, t, f)
+            lambda f, c=conn, rid=req_id, t=t0, tid=trace_id:
+            self._complete(c, rid, t, f, tid)
         )
 
     def _complete(self, conn: _NetConn, req_id: int, t0: float,
-                  fut) -> None:
+                  fut, trace_id: int = 0) -> None:
         """Batcher-thread callback: encode the reply and queue it on the
         connection's outbox (or count it orphaned if the client is gone —
         it has already reconnected and retried elsewhere)."""
@@ -401,6 +417,7 @@ class ServingNetServer:
         if exc is None:
             self.replies += 1
             self.latency.record(time.monotonic() - t0)
+            self.spans.record(trace_id, "serve.request", t0, wid=conn.wid)
 
     # -- batched fleet inference (F_IREQ/F_IREP) ---------------------------
 
@@ -425,7 +442,10 @@ class ServingNetServer:
         reply carries greedy actions + q rows, the worker's ladder slice
         stays worker-side (pinned by test)."""
         t0 = time.monotonic()
+        trace_id = 0
         try:
+            if conn.flags & HELLO_FLAG_TRACE:
+                trace_id, payload = split_trace(payload)
             req_id, rows = decode_inference_request(
                 payload, allow_zlib=conn.codec != CODEC_OFF,
                 max_bytes=self._max_frame,
@@ -459,7 +479,8 @@ class ServingNetServer:
             return
         conn.inflight += 1
         agg = {"lock": threading.Lock(), "left": len(futures),
-               "rows": [None] * len(futures), "exc": None}
+               "rows": [None] * len(futures), "exc": None,
+               "trace_id": trace_id, "t_submit": time.monotonic()}
         for i, fut in enumerate(futures):
             fut.add_done_callback(
                 lambda f, c=conn, rid=req_id, t=t0, a=agg, k=i:
@@ -508,6 +529,13 @@ class ServingNetServer:
         self.inference_replies += 1
         self._source_count(conn.wid, replies=1)
         self.latency.record(time.monotonic() - t0)
+        # Two hops of the e2e inference timeline: the replica's whole
+        # service span (decode → reply queued) and the batcher leg inside
+        # it (rows submitted → last row's future landed).
+        tid = agg["trace_id"]
+        self.spans.record(tid, "serve.infer", t0, wid=conn.wid,
+                          rows=len(results))
+        self.spans.record(tid, "serve.batch", agg["t_submit"], wid=conn.wid)
 
     def _enqueue(self, conn: _NetConn, kind: int, body: bytes) -> bool:
         """Queue one outbound frame; False if the connection is gone.
@@ -573,6 +601,11 @@ class ServingNetServer:
             + self._bytes_out_closed,
             "param_version": int(getattr(self._server, "param_version", -1)),
             "latency": self.latency.summary(),
+            # Fleet-rollup surfaces (obs/fleet.py): raw buckets so the
+            # aggregator can merge replicas bucket-wise, and this
+            # process's recent cross-tier trace spans.
+            "latency_buckets": self.latency.buckets(),
+            "recent_spans": self.spans.snapshot(),
         }
 
 
@@ -590,12 +623,20 @@ class ServingClient:
     def __init__(self, host: str, port: int, *,
                  connect_timeout_s: float = 2.0,
                  io_timeout_s: float = 5.0, seed: int = 0,
-                 max_frame: int = 64 << 20):
+                 max_frame: int = 64 << 20, trace: bool = False,
+                 token: int = 0):
         self.host = host
         self.port = int(port)
         self._connect_timeout = float(connect_timeout_s)
         self._io_timeout = float(io_timeout_s)
         self._max_frame = int(max_frame)
+        # Tracing needs the v2 hello (the flags byte lives in its
+        # extension); a plain client keeps the anonymous v1 hello and the
+        # bit-identical wire.  ``token`` rides the v2 hello so a traced
+        # client can still talk to a run-token-locked fleet port.
+        self.trace = bool(trace)
+        self._token = int(token)
+        self.spans = TraceSpanLog(depth=64)
         self._sock: Optional[socket.socket] = None
         self._parser = FrameParser(max_frame=max_frame)
         self._backoff = Backoff(base_s=0.05, max_s=1.0, seed=seed)
@@ -626,7 +667,11 @@ class ServingClient:
                 (self.host, self.port), timeout=self._connect_timeout
             )
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            sock.sendall(serve_hello_bytes())
+            sock.sendall(
+                serve_hello_ext_bytes(0, 0, self._token,
+                                      flags=HELLO_FLAG_TRACE)
+                if self.trace else serve_hello_bytes()
+            )
             sock.settimeout(self._io_timeout)
         except OSError:
             self._backoff.fail()
@@ -643,13 +688,15 @@ class ServingClient:
 
     # -- request path ------------------------------------------------------
 
-    def act(self, obs, timeout: float = 30.0) -> ServedAction:
+    def act(self, obs, timeout: float = 30.0,
+            trace_id: int = 0) -> ServedAction:
         """One observation → one ServedAction, across reconnects.
 
         Raises :class:`ServerOverloaded` on a typed shed reply (counted
         on ``shed_seen`` — the caller decides whether to retry),
         :class:`ServingError` on other typed refusals, and
-        ``TimeoutError`` when the deadline expires unanswered."""
+        ``TimeoutError`` when the deadline expires unanswered.
+        ``trace_id`` rides the trace prefix on a trace-mode client."""
         t_start = time.monotonic()
         deadline = t_start + timeout
         first_try = True
@@ -663,10 +710,12 @@ class ServingClient:
             self._req_id += 1
             rid = self._req_id
             try:
+                payload = encode_request(rid, obs)
+                if self.trace:
+                    payload = wrap_trace(trace_id, payload)
                 self._out_seq += 1
                 self._sock.sendall(
-                    frame_bytes(F_SREQ, self._out_seq,
-                                [encode_request(rid, obs)])
+                    frame_bytes(F_SREQ, self._out_seq, [payload])
                 )
                 got = self._await_reply(rid, deadline)
             except (OSError, socket.timeout):
@@ -679,6 +728,8 @@ class ServingClient:
             if kind == F_SREP:
                 self._backoff.reset()
                 req_id, action, version, q = decode_reply(payload)
+                self.spans.record(trace_id if self.trace else 0,
+                                  "serve.request.client", t_start)
                 return ServedAction(action, q, version,
                                     time.monotonic() - t_start)
             req_id, code, msg = decode_error(payload)

@@ -184,6 +184,37 @@ class LatencyHistogram:
             self._sum += total
             self._max = max(self._max, mx)
 
+    def state_dict(self) -> dict:
+        """The histogram's full internal state as JSON-shippable plain
+        types — what crosses a process boundary when the OBJECT cannot
+        (worker control queues, /varz scrapes).  ``merge_state`` on the
+        receiving side is bit-equivalent to ``merge`` on the object."""
+        with self._lock:
+            return {
+                "min_s": self._min,
+                "per_decade": self._per,
+                "counts": list(self._counts),
+                "count": self._count,
+                "sum": self._sum,
+                "max": self._max,
+            }
+
+    def merge_state(self, state: dict) -> bool:
+        """Fold one shipped ``state_dict`` into this histogram; False (a
+        silent no-op would corrupt percentiles) when the bucket layout
+        disagrees — callers treat that as an unmergeable source."""
+        counts = state.get("counts")
+        if (not counts or len(counts) != len(self._counts)
+                or float(state.get("min_s", self._min)) != self._min
+                or int(state.get("per_decade", self._per)) != self._per):
+            return False
+        with self._lock:
+            self._counts = [a + int(b) for a, b in zip(self._counts, counts)]
+            self._count += int(state.get("count", 0))
+            self._sum += float(state.get("sum", 0.0))
+            self._max = max(self._max, float(state.get("max", 0.0)))
+        return True
+
     def buckets(self) -> dict:
         """Non-empty buckets as {upper_edge_seconds: count} (plus
         ``"+Inf"`` for overflow) — the raw distribution for /varz scrapes
@@ -288,6 +319,73 @@ class TransportStats:
             "salvaged_records": self.salvaged_records,
             "torn_records": self.torn_records,
         }
+
+
+# ---------------------------------------------------------------------------
+# Cross-process merge arithmetic on the SERIALIZED metric forms.  A fleet
+# rollup (obs/fleet.py) only ever sees JSON — bucket dicts off /varz,
+# counter maps off a stats RPC — so the merge() discipline the objects
+# have needs twins that operate on those forms.  All three are
+# associative and commutative (pinned by tests/test_metrics_edge.py):
+# merging shard A into B into C equals any other order, which is what
+# makes an aggregator restart or a re-scrape harmless.
+# ---------------------------------------------------------------------------
+
+
+def merge_bucket_dicts(a: dict, b: dict) -> dict:
+    """Per-edge count sum of two ``LatencyHistogram.buckets()`` dicts —
+    the serialized twin of ``LatencyHistogram.merge`` (same-layout
+    histograms emit identical edge keys, so key-wise addition IS the
+    bucket-wise merge)."""
+    out = dict(a)
+    for edge, count in b.items():
+        out[edge] = out.get(edge, 0) + count
+    return out
+
+
+def bucket_percentile(buckets: dict, p: float) -> float:
+    """The p-th percentile (seconds) of a merged buckets dict: the upper
+    edge of the bucket holding rank p — the same one-bucket-width error
+    contract as ``LatencyHistogram.percentile``.  NaN when empty; the
+    overflow bucket reports inf (the merge lost the true max)."""
+    items = []
+    inf_count = 0
+    for edge, count in buckets.items():
+        if edge == "+Inf":
+            inf_count = int(count)
+        else:
+            items.append((float(edge), int(count)))
+    items.sort()
+    total = sum(c for _, c in items) + inf_count
+    if total == 0:
+        return float("nan")
+    rank = max(1, math.ceil(p / 100.0 * total))
+    cum = 0
+    for edge, count in items:
+        cum += count
+        if cum >= rank:
+            return edge
+    return float("inf")
+
+
+def merge_counter_maps(a: dict, b: dict) -> dict:
+    """Recursive numeric-leaf sum of two plain counter/gauge maps (shard
+    op counts, per-source dicts): dict values merge recursively, numeric
+    leaves add, and a key present on one side rides through unchanged.
+    Non-numeric leaf conflicts keep ``a``'s value (deterministic, order-
+    stable under the sorted-endpoint iteration the rollup uses)."""
+    out = dict(a)
+    for k, v in b.items():
+        cur = out.get(k)
+        if isinstance(cur, dict) and isinstance(v, dict):
+            out[k] = merge_counter_maps(cur, v)
+        elif isinstance(cur, bool) or isinstance(v, bool):
+            out[k] = cur if k in out else v
+        elif isinstance(cur, (int, float)) and isinstance(v, (int, float)):
+            out[k] = cur + v
+        elif k not in out:
+            out[k] = v
+    return out
 
 
 def emit_event(event: str, stream: Optional[IO] = None, **fields) -> dict:

@@ -162,7 +162,16 @@ class TestHelloToken:
         h = serve_hello_ext_bytes(3, 2, 99, CODEC_ZLIB)
         ext = parse_serve_hello_ext(h[8:])
         assert ext == {"wid": 3, "attempt": 2, "token": 99,
-                       "codec": CODEC_ZLIB}
+                       "codec": CODEC_ZLIB, "flags": 0}
+        # The flags byte lives in what was pad: a flags-0 hello is
+        # byte-identical to the pre-flags wire, and a trace-flagged one
+        # round-trips the bit.
+        from ape_x_dqn_tpu.runtime.net import HELLO_FLAG_TRACE
+
+        traced = serve_hello_ext_bytes(3, 2, 99, CODEC_ZLIB,
+                                       flags=HELLO_FLAG_TRACE)
+        assert parse_serve_hello_ext(traced[8:])["flags"] == HELLO_FLAG_TRACE
+        assert traced != h and len(traced) == len(h)
 
     def test_wrong_token_rejected_before_framing(self, net_server):
         s = socket.create_connection(("127.0.0.1", net_server.port), 5.0)

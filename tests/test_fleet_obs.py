@@ -1,0 +1,632 @@
+"""Fleet observability plane (ISSUE 14): SLO rule evaluation, the
+rollup aggregator's merge/liveness contracts, the fleet schema pins,
+and cross-tier trace propagation — including the e2e pin that one
+trace id surfaces in spans from >= 3 distinct pids across the
+replay-RPC and inference hops, and the trace-field-off wire staying
+bit-identical to the pre-flags frames."""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.obs.fleet import (
+    FleetAggregator,
+    SloEngine,
+    SloRule,
+    _endpoints_down,
+    rules_from_config,
+)
+from ape_x_dqn_tpu.utils.metrics import LatencyHistogram
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OBS = (6,)
+
+
+def _doc_keys(section_header):
+    from ape_x_dqn_tpu.analysis.metrics_doc import doc_section_keys
+
+    return doc_section_keys(
+        section_header, os.path.join(REPO, "docs", "METRICS.md"))
+
+
+# ---------------------------------------------------------------------------
+# SLO engine units: breach, burn window, clear, flap damping.
+# ---------------------------------------------------------------------------
+
+
+def _engine(emit_list, *, bound=100.0, kind="upper", window_s=10.0,
+            burn=0.5, clear=0.1, min_samples=3):
+    return SloEngine(
+        [SloRule("r", kind, bound, lambda r: r.get("v"))],
+        window_s=window_s, burn_threshold=burn, clear_threshold=clear,
+        min_samples=min_samples,
+        emit=lambda name, **f: emit_list.append((name, f)),
+    )
+
+
+class TestSloEngine:
+    def test_single_bad_sample_is_not_a_breach(self):
+        events = []
+        eng = _engine(events, min_samples=3)
+        eng.evaluate({"v": 500.0}, now=0.0)
+        eng.evaluate({"v": 50.0}, now=1.0)
+        assert eng.rules[0].state == "ok" and not events
+
+    def test_breach_fires_at_burn_threshold_then_clears(self):
+        events = []
+        eng = _engine(events)
+        t = 0.0
+        for _ in range(4):
+            eng.evaluate({"v": 500.0}, now=t)
+            t += 1.0
+        assert eng.rules[0].state == "breach"
+        assert [e[0] for e in events] == ["slo_breach"]
+        ev = events[0][1]
+        assert ev["rule"] == "r" and ev["bound"] == 100.0 \
+            and ev["burn"] >= 0.5
+        # Recovery: good samples push burn under clear_threshold only
+        # once the bad window expires.
+        for _ in range(20):
+            eng.evaluate({"v": 10.0}, now=t)
+            t += 1.0
+        assert eng.rules[0].state == "ok"
+        assert [e[0] for e in events] == ["slo_breach", "slo_clear"]
+
+    def test_burn_window_expires_old_samples(self):
+        events = []
+        eng = _engine(events, window_s=5.0)
+        eng.evaluate({"v": 500.0}, now=0.0)
+        eng.evaluate({"v": 500.0}, now=1.0)
+        # 10s later the bad samples left the window: three fresh good
+        # samples keep the rule ok even though 2/5 lifetime were bad.
+        for t in (10.0, 11.0, 12.0):
+            eng.evaluate({"v": 10.0}, now=t)
+        assert eng.rules[0].state == "ok" and not events
+
+    def test_flapping_is_damped_by_hysteresis(self):
+        """A value oscillating across the bound every sweep holds burn
+        ~0.5 — above clear (0.2), below breach (0.8) after the initial
+        window: NO transition storm (the band is the contract)."""
+        events = []
+        eng = _engine(events, burn=0.8, clear=0.2)
+        t = 0.0
+        for i in range(60):
+            eng.evaluate({"v": 500.0 if i % 2 else 10.0}, now=t)
+            t += 1.0
+        assert len(events) <= 1   # at most one initial transition, no storm
+
+    def test_lower_bound_rule_and_none_skips(self):
+        events = []
+        eng = SloEngine(
+            [SloRule("qps", "lower", 10.0, lambda r: r.get("qps"))],
+            window_s=10.0, burn_threshold=0.5, clear_threshold=0.1,
+            min_samples=2,
+            emit=lambda name, **f: events.append((name, f)),
+        )
+        t = 0.0
+        for _ in range(4):
+            eng.evaluate({}, now=t)       # unmeasurable: skipped entirely
+            t += 1.0
+        assert eng.rules[0].state == "ok" and not events
+        assert eng.rules[0]._window == eng.rules[0]._window  # no samples
+        for _ in range(3):
+            eng.evaluate({"qps": 2.0}, now=t)
+            t += 1.0
+        assert eng.rules[0].state == "breach"
+        assert events[0][1]["kind"] == "lower"
+
+    def test_threshold_ordering_validated(self):
+        with pytest.raises(ValueError):
+            SloEngine([], burn_threshold=0.2, clear_threshold=0.5)
+
+    def test_rules_from_config_defaults_and_knobs(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+
+        cfg = ApexConfig()
+        names = {r.name for r in rules_from_config(cfg.obs)}
+        assert names == {"endpoints_alive"}   # only liveness by default
+        cfg.obs.fleet_slo_age_p95_ms = 2000.0
+        cfg.obs.fleet_slo_serving_p99_ms = 50.0
+        cfg.obs.fleet_slo_serving_qps_min = 5.0
+        cfg.obs.fleet_slo_ring_occupancy_high = 0.9
+        cfg.obs.fleet_slo_inference_rtt_p99_ms = 100.0
+        cfg.validate()
+        names = {r.name for r in rules_from_config(cfg.obs)}
+        assert names == {
+            "endpoints_alive", "age_p95_ms", "serving_p99_ms",
+            "serving_qps", "ring_occupancy", "inference_rtt_p99_ms",
+        }
+
+    def test_config_validation_rejects_bad_bands(self):
+        from ape_x_dqn_tpu.config import ApexConfig
+
+        cfg = ApexConfig()
+        cfg.obs.fleet_slo_clear_threshold = 0.9   # > burn_threshold
+        with pytest.raises(ValueError, match="clear"):
+            cfg.validate()
+        cfg = ApexConfig()
+        cfg.obs.fleet_slo_ring_occupancy_low = 0.8
+        cfg.obs.fleet_slo_ring_occupancy_high = 0.5
+        with pytest.raises(ValueError, match="occupancy"):
+            cfg.validate()
+
+
+# ---------------------------------------------------------------------------
+# Aggregator: merge + liveness + schema.
+# ---------------------------------------------------------------------------
+
+
+def _fake_trainer_varz(age_values=(0.5, 1.0, 2.0), spans=()):
+    """A registry shaped like a trainer's /varz, served over HTTP."""
+    from ape_x_dqn_tpu.obs.exporter import ObsServer
+    from ape_x_dqn_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = LatencyHistogram(min_s=1e-3, max_s=7200.0, per_decade=10)
+    for v in age_values:
+        h.record(v)
+    reg.register_provider("lineage", lambda: {
+        "age_at_sample": {"count": h.count, "buckets_s": h.buckets()},
+    })
+    reg.register_provider("trace_spans", lambda: {
+        "recorded": len(spans), "spans": list(spans),
+    })
+    reg.register_provider("learner", lambda: {
+        "step": 7, "steps_per_sec": 3.0,
+    })
+    return ObsServer(reg), h
+
+
+@pytest.fixture
+def shard():
+    from ape_x_dqn_tpu.replay.buffer import PrioritizedReplay
+    from ape_x_dqn_tpu.replay.service import ReplayShardServer
+
+    rep = PrioritizedReplay(256, OBS)
+    srv = ReplayShardServer(rep, 0, token=5, codec="off").start()
+    yield rep, srv
+    srv.close()
+
+
+def _endpoints_file(tmp_path, srv):
+    path = str(tmp_path / "endpoints.json")
+    with open(path, "w") as f:
+        json.dump({
+            "token": srv.token, "codec": "off", "total_capacity": 256,
+            "shards": [{"id": 0, "host": "127.0.0.1", "port": srv.port,
+                        "base": 0, "capacity": 256,
+                        "incarnation": srv.incarnation}],
+        }, f)
+    return path
+
+
+class TestFleetAggregator:
+    def test_rollup_merges_and_marks_dead_endpoint(self, shard, tmp_path):
+        rep, srv = shard
+        t1, h1 = _fake_trainer_varz(age_values=(0.5, 1.0))
+        t2, h2 = _fake_trainer_varz(age_values=(2.0, 4.0, 8.0))
+        events = []
+        agg = FleetAggregator(
+            slo=SloEngine(
+                [SloRule("endpoints_alive", "upper", 0.0, _endpoints_down)],
+                window_s=60.0, min_samples=2,
+            ),
+            emit=lambda name, **f: events.append((name, f)),
+        )
+        try:
+            agg.add_varz("trainer_a", t1.url)
+            agg.add_varz("trainer_b", t2.url)
+            agg.add_varz("dead", "http://127.0.0.1:1/varz", kind="replica")
+            agg.watch_replay_endpoints(_endpoints_file(tmp_path, srv))
+            for i in range(3):
+                rollup = agg.scrape_once(now=float(i))
+            eps = rollup["endpoints"]
+            assert set(eps) == {"trainer_a", "trainer_b", "dead",
+                                "replay_shard0"}
+            assert eps["trainer_a"]["alive"] and eps["replay_shard0"]["alive"]
+            assert not eps["dead"]["alive"]
+            assert eps["dead"]["scrape_failures"] == 3
+            assert rollup["alive"] == 3 and rollup["expected"] == 4
+            # Age histograms merged BUCKET-WISE across both trainers.
+            age = rollup["age_of_experience"]
+            assert age["count"] == 5
+            ref = LatencyHistogram(min_s=1e-3, max_s=7200.0, per_decade=10)
+            ref.merge(h1)
+            ref.merge(h2)
+            assert age["buckets_s"] == ref.buckets()
+            # Shard scraped over its own stats RPC; counters summed in.
+            assert rollup["replay"]["shards_alive"] == 1
+            assert rollup["replay"]["requests"] >= 1
+            # One sustained dead endpoint = a liveness breach.
+            assert [e[0] for e in events] == ["slo_breach"]
+        finally:
+            agg.close()
+            t1.close()
+            t2.close()
+
+    def test_rollup_serves_and_never_503s_on_member_death(self, tmp_path):
+        t1, _h = _fake_trainer_varz()
+        agg = FleetAggregator()
+        try:
+            agg.add_varz("trainer", t1.url)
+            agg.add_varz("dead", "http://127.0.0.1:1/varz")
+            agg.scrape_once(now=0.0)
+            obs = agg.serve(port=0)
+            snap = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{obs.port}/varz", timeout=5.0))
+            assert "fleet" in snap and "slo" in snap
+            assert not snap["fleet"]["endpoints"]["dead"]["alive"]
+            # The rollup's own health is its scrape loop — 200 despite
+            # the dead member.
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{obs.port}/healthz", timeout=5.0
+            ) as r:
+                assert r.status == 200
+            prom = urllib.request.urlopen(
+                f"http://127.0.0.1:{obs.port}/metrics", timeout=5.0
+            ).read().decode()
+            assert "apex_fleet_scrape_failures" in prom
+        finally:
+            agg.close()
+            t1.close()
+
+    def test_fleet_and_slo_sections_match_doc(self, tmp_path):
+        t1, _h = _fake_trainer_varz()
+        agg = FleetAggregator()
+        try:
+            agg.add_varz("trainer", t1.url)
+            rollup = agg.scrape_once(now=0.0)
+        finally:
+            agg.close()
+            t1.close()
+        doc = _doc_keys("## Fleet rollup schema")
+        assert doc, "Fleet rollup schema doc section missing"
+        assert set(doc) == set(rollup), set(doc) ^ set(rollup)
+        slo_doc = _doc_keys("## SLO schema")
+        assert slo_doc, "SLO schema doc section missing"
+        status = SloEngine([SloRule("x", "upper", 1.0, lambda r: 0.0)]) \
+            .status()
+        assert set(slo_doc) == set(status), set(slo_doc) ^ set(status)
+
+    def test_timeline_assembly_requires_two_pids(self):
+        agg = FleetAggregator()
+        agg._fold_traces([
+            {"trace_id": 9, "hop": "act", "pid": 1, "t0_s": 1.0,
+             "t1_s": 1.0, "dur_ms": 0.0},
+            {"trace_id": 9, "hop": "rsvc.add", "pid": 2, "t0_s": 1.1,
+             "t1_s": 1.3, "dur_ms": 200.0},
+            {"trace_id": 8, "hop": "rsvc.add.client", "pid": 3,
+             "t0_s": 2.0, "t1_s": 2.1, "dur_ms": 100.0},
+        ])
+        tl = agg._timelines()
+        assert [t["trace_id"] for t in tl] == [9]   # single-pid 8 filtered
+        assert tl[0]["pids"] == [1, 2]
+        assert tl[0]["hops"] == ["act", "rsvc.add"]
+
+
+# ---------------------------------------------------------------------------
+# Wire pins: trace off = bit-identical frames; version-gated hellos.
+# ---------------------------------------------------------------------------
+
+
+class TestTraceWire:
+    def test_serve_hello_flags_off_is_preflags_bytes(self):
+        from ape_x_dqn_tpu.runtime.net import (
+            SERVE_HELLO,
+            SERVE_MAGIC,
+            SERVE_VERSION_EXT,
+            serve_hello_ext_bytes,
+        )
+
+        legacy = SERVE_HELLO.pack(SERVE_MAGIC, SERVE_VERSION_EXT) + \
+            struct.Struct("<qqqB7x").pack(3, 2, 99, 1)
+        assert serve_hello_ext_bytes(3, 2, 99, 1) == legacy
+
+    def test_rsvc_hello_flags_off_is_preflags_bytes(self):
+        from ape_x_dqn_tpu.replay.service import (
+            RSVC_HELLO,
+            RSVC_MAGIC,
+            RSVC_VERSION,
+        )
+
+        legacy = struct.Struct("<4sIqqqqB7x").pack(
+            RSVC_MAGIC, RSVC_VERSION, 9, 0, -1, 5, 0)
+        assert RSVC_HELLO.pack(RSVC_MAGIC, RSVC_VERSION, 9, 0, -1, 5,
+                               0, 0) == legacy
+
+    def test_preflags_raw_client_still_served(self, shard):
+        """A client speaking the OLD hello struct byte-for-byte (no
+        flags knowledge at all) handshakes and gets its add applied —
+        today's wire is a valid member of tomorrow's fleet."""
+        import socket
+
+        from ape_x_dqn_tpu.replay.service import (
+            _RPC,
+            OP_DIGEST,
+            RSVC_ACK,
+            RSVC_MAGIC,
+            RSVC_VERSION,
+        )
+        from ape_x_dqn_tpu.runtime.net import F_RREQ, FrameParser, frame_bytes
+
+        rep, srv = shard
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5.0)
+        s.sendall(struct.Struct("<4sIqqqqB7x").pack(
+            RSVC_MAGIC, RSVC_VERSION, 9, 0, -1, srv.token, 0))
+        s.settimeout(5.0)
+        ack = b""
+        while len(ack) < RSVC_ACK.size:
+            ack += s.recv(RSVC_ACK.size - len(ack))
+        s.sendall(frame_bytes(F_RREQ, 1, [_RPC.pack(1, OP_DIGEST)]))
+        parser = FrameParser()
+        deadline = time.monotonic() + 5.0
+        got = None
+        while got is None and time.monotonic() < deadline:
+            parser.feed(s.recv(1 << 16))
+            got = parser.next()
+        assert got is not None and srv.torn_frames == 0
+        s.close()
+
+    def test_traced_payload_is_prefix_plus_legacy(self):
+        from ape_x_dqn_tpu.runtime.net import split_trace, wrap_trace
+
+        body = b"legacy-request-bytes"
+        wrapped = wrap_trace(1234, body)
+        assert wrapped[8:] == body
+        tid, rest = split_trace(wrapped)
+        assert tid == 1234 and bytes(rest) == body
+        with pytest.raises(ValueError):
+            split_trace(b"short")
+
+    def test_untraced_clients_record_no_spans(self, shard):
+        from ape_x_dqn_tpu.replay.service import ShardedReplayClient
+
+        rep, srv = shard
+        cl = ShardedReplayClient(
+            [{"id": 0, "host": "127.0.0.1", "port": srv.port, "base": 0,
+              "capacity": 256, "incarnation": srv.incarnation}],
+            token=srv.token, codec="off", trace=False,
+            request_timeout_s=5.0,
+        )
+        try:
+            arrays = _chunk()
+            cl.add(arrays["prio"], _Batch(arrays), trace_id=999)
+            assert cl.spans.snapshot()["spans"] == []
+            assert srv.stats()["trace_spans"]["spans"] == []
+        finally:
+            cl.close()
+
+
+def _chunk(n=8, seed=0):
+    r = np.random.default_rng(seed)
+    return {
+        "prio": (np.abs(r.normal(size=n)) + 0.1).astype(np.float64),
+        "obs": r.integers(0, 255, (n, *OBS), dtype=np.uint8),
+        "action": r.integers(0, 2, n).astype(np.int32),
+        "reward": r.normal(size=n).astype(np.float32),
+        "discount": np.full(n, 0.99, np.float32),
+        "next_obs": r.integers(0, 255, (n, *OBS), dtype=np.uint8),
+    }
+
+
+class _Batch:
+    def __init__(self, arrays):
+        for k, v in arrays.items():
+            setattr(self, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Cross-tier e2e: one trace id, >= 3 distinct pids, both RPC planes.
+# ---------------------------------------------------------------------------
+
+_SERVING_CHILD = r"""
+import concurrent.futures, json, os, sys
+import numpy as np
+from ape_x_dqn_tpu.serving.net_server import ServingNetServer
+from ape_x_dqn_tpu.serving.batcher import ServedAction
+
+
+class Stub:
+    param_version = 3
+
+    def submit(self, obs):
+        f = concurrent.futures.Future()
+        f.set_result(ServedAction(1, np.zeros(2, np.float32), 3, 0.0))
+        return f
+
+
+srv = ServingNetServer(Stub()).start()
+print(json.dumps({"port": srv.port, "pid": os.getpid()}), flush=True)
+sys.stdin.readline()
+print(json.dumps(srv.stats()["recent_spans"]), flush=True)
+srv.close()
+"""
+
+
+class TestCrossTierTraceE2E:
+    def test_same_trace_id_in_three_pids_across_both_planes(self, tmp_path):
+        """The acceptance pin: ONE trace id appears in spans recorded by
+        >= 3 distinct processes, across the replay-RPC hops (client in
+        this pid, shard server in its own) AND the inference hops
+        (serving replica in a third pid) — and the aggregator assembles
+        them into one timeline."""
+        from ape_x_dqn_tpu.replay.service import ShardClient, \
+            ShardedReplayClient
+        from ape_x_dqn_tpu.serving.central import CentralInferenceClient
+
+        env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+        tid = 0x7ACE
+
+        # Shard in its own process (numpy-only CLI, sub-second spawn).
+        shard_proc = subprocess.Popen(
+            [sys.executable, "-m", "ape_x_dqn_tpu.replay.service",
+             "--shard-id", "0", "--capacity", "256", "--obs-shape", "6",
+             "--token", "5", "--port", "0", "--codec", "off"],
+            cwd=REPO, env=env, stdin=subprocess.DEVNULL,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        )
+        serve_proc = subprocess.Popen(
+            [sys.executable, "-c", _SERVING_CHILD],
+            cwd=REPO, env=env, stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        )
+        cl = None
+        inf = None
+        try:
+            announce = json.loads(shard_proc.stdout.readline())
+            assert announce["event"] == "replay_shard_listen"
+            shard_pid, shard_port = announce["pid"], announce["port"]
+
+            # Replay plane: traced add + sample + write-back.
+            cl = ShardedReplayClient(
+                [{"id": 0, "host": "127.0.0.1", "port": shard_port,
+                  "base": 0, "capacity": 256, "incarnation": 0}],
+                token=5, codec="off", trace=True, request_timeout_s=10.0,
+            )
+            arrays = _chunk()
+            idx = cl.add(arrays["prio"], _Batch(arrays), trace_id=tid)
+            batch = cl.sample(4)
+            cl.tag_sample_span(tid)
+            cl.update_priorities(batch.indices.astype(np.int64),
+                                 np.ones(4), trace_id=tid)
+            sc = ShardClient(0, "127.0.0.1", shard_port, token=5,
+                             client_id=42, codec="off")
+            shard_stats = sc.shard_stats(timeout=10.0)
+            sc.close()
+
+            # Inference plane: the SAME trace id through a replica in a
+            # third pid.
+            serving = json.loads(serve_proc.stdout.readline())
+            inf = CentralInferenceClient("127.0.0.1", serving["port"],
+                                         wid=1, trace=True)
+            inf.select(np.zeros((2, 6), np.uint8), timeout_s=20.0,
+                       trace_id=tid)
+            serve_proc.stdin.write(b"dump\n")
+            serve_proc.stdin.flush()
+            replica_spans = json.loads(serve_proc.stdout.readline())
+
+            spans = (
+                cl.spans.snapshot()["spans"]
+                + inf.spans.snapshot()["spans"]
+                + shard_stats["trace_spans"]["spans"]
+                + replica_spans["spans"]
+            )
+            ours = [s for s in spans if s["trace_id"] == tid]
+            pids = {s["pid"] for s in ours}
+            hops = {s["hop"] for s in ours}
+            assert len(pids) >= 3, (pids, hops)
+            assert os.getpid() in pids and shard_pid in pids \
+                and serving["pid"] in pids
+            # Both planes crossed: replay-RPC hops and inference hops.
+            assert {"rsvc.add.client", "rsvc.add"} <= hops
+            assert "rsvc.update" in hops and "rsvc.sample.client" in hops
+            assert {"inf.select.client", "serve.infer"} <= hops
+            # And the aggregator folds them into ONE timeline.
+            agg = FleetAggregator()
+            agg._fold_traces(ours)
+            tl = agg._timelines()
+            assert len(tl) == 1 and tl[0]["trace_id"] == tid
+            assert len(tl[0]["pids"]) >= 3
+        finally:
+            if cl is not None:
+                cl.close()
+            if inf is not None:
+                inf.close()
+            for p in (shard_proc, serve_proc):
+                p.terminate()
+                try:
+                    p.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=5.0)
+                if p.stdout is not None:
+                    p.stdout.close()
+                if p.stdin is not None:
+                    p.stdin.close()
+
+
+class TestTraceThroughRouter:
+    def test_traced_request_splices_intact_through_router(self):
+        """The router balances CONNECTIONS and never parses frames — a
+        trace-negotiated hello + trace-prefixed request must ride the
+        splice byte-for-byte and surface as a server-side span."""
+        import concurrent.futures
+
+        from ape_x_dqn_tpu.serving.batcher import ServedAction
+        from ape_x_dqn_tpu.serving.net_server import (
+            ServingClient,
+            ServingNetServer,
+        )
+        from ape_x_dqn_tpu.serving.router import ServingRouter
+
+        class _Stub:
+            param_version = 1
+
+            def submit(self, obs):
+                f = concurrent.futures.Future()
+                f.set_result(ServedAction(0, np.zeros(2, np.float32), 1,
+                                          0.0))
+                return f
+
+        srv = ServingNetServer(_Stub()).start()
+        router = ServingRouter(port=0)
+        router.set_endpoint(0, "127.0.0.1", srv.port)
+        router.start()
+        cl = ServingClient("127.0.0.1", router.port, trace=True)
+        try:
+            cl.act(np.zeros(OBS, np.uint8), timeout=15.0, trace_id=4321)
+            deadline = time.monotonic() + 5.0
+            spans = []
+            while time.monotonic() < deadline and not spans:
+                spans = [s for s in srv.stats()["recent_spans"]["spans"]
+                         if s["trace_id"] == 4321]
+                time.sleep(0.05)
+            assert spans and spans[0]["hop"] == "serve.request"
+            assert srv.stats()["torn_frames"] == 0
+        finally:
+            cl.close()
+            router.close()
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Worker trace sweep (the pool's shm-event-ring half).
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerTraceSweep:
+    def test_trace_chunk_events_lift_into_act_spans(self):
+        from ape_x_dqn_tpu.obs.shm_stats import WORKER_SLOTS, WorkerStatsBlock
+        from ape_x_dqn_tpu.runtime.process_actors import ProcessActorPool
+
+        blk = WorkerStatsBlock(slots=WORKER_SLOTS)
+        try:
+            blk.record_event({"t": 12.5, "kind": "trace_chunk",
+                              "trace_id": 321, "rows": 8})
+            blk.record_event({"t": 13.0, "kind": "trace_span",
+                              "trace_id": 321, "hop": "inf.select.client",
+                              "pid": blk.pid, "t0_s": 12.9, "t1_s": 13.0,
+                              "dur_ms": 100.0})
+            blk.record_event({"t": 13.5, "kind": "error", "error": "x"})
+
+            class _Fake:
+                _stats_blocks = {3: blk}
+
+            spans = ProcessActorPool.trace_events(_Fake())
+            assert len(spans) == 2
+            act = next(s for s in spans if s["hop"] == "act")
+            assert act["trace_id"] == 321 and act["wid"] == 3
+            assert act["pid"] == blk.pid
+            assert any(s["hop"] == "inf.select.client" for s in spans)
+        finally:
+            blk.close()
+            blk.unlink()

@@ -14,7 +14,10 @@ from ape_x_dqn_tpu.utils.metrics import (
     MetricLogger,
     RateCounter,
     TransportStats,
+    bucket_percentile,
     emit_event,
+    merge_bucket_dicts,
+    merge_counter_maps,
 )
 
 
@@ -102,6 +105,109 @@ class TestTransportStatsMerge:
         assert a.bytes == 7000
         # Window rates interleave — the merged rate sees all three chunks.
         assert a.chunk_rate.total == 3.0
+
+
+class TestSerializedMerges:
+    """The fleet rollup's merge arithmetic (ISSUE 14 satellite): the
+    SERIALIZED twins of the object-level merge() — bucket dicts, counter
+    maps, shipped histogram states — pinned associative + commutative,
+    because an aggregator restart / re-scrape must not change the math."""
+
+    def _hists(self):
+        hs = []
+        for vals in ((0.001, 0.01), (0.1, 1.0), (5.0, 0.002, 0.3)):
+            h = LatencyHistogram()
+            for v in vals:
+                h.record(v)
+            hs.append(h)
+        return hs
+
+    def test_bucket_merge_matches_object_merge(self):
+        a, b, _ = self._hists()
+        merged = merge_bucket_dicts(a.buckets(), b.buckets())
+        a.merge(b)
+        assert merged == a.buckets()
+        # Percentiles off the merged buckets = the object's bucket edges
+        # (clamp-to-max aside, which serialization cannot carry).
+        assert bucket_percentile(merged, 50) <= a.percentile(95) * 10
+
+    def test_bucket_merge_associative_commutative(self):
+        a, b, c = (h.buckets() for h in self._hists())
+        ab_c = merge_bucket_dicts(merge_bucket_dicts(a, b), c)
+        a_bc = merge_bucket_dicts(a, merge_bucket_dicts(b, c))
+        assert ab_c == a_bc
+        assert merge_bucket_dicts(a, b) == merge_bucket_dicts(b, a)
+
+    def test_bucket_percentile_empty_and_overflow(self):
+        assert math.isnan(bucket_percentile({}, 50))
+        assert bucket_percentile({"+Inf": 3}, 99) == float("inf")
+
+    def test_state_dict_merge_matches_object_merge(self):
+        a, b, _ = self._hists()
+        ref = LatencyHistogram()
+        ref.merge(a)
+        ref.merge(b)
+        target = LatencyHistogram()
+        assert target.merge_state(a.state_dict())
+        assert target.merge_state(b.state_dict())
+        assert target.state_dict() == ref.state_dict()
+        # Layout mismatch: refused, never silently misaligned.
+        other = LatencyHistogram(min_s=1e-3)
+        assert not other.merge_state(a.state_dict())
+
+    def test_counter_map_merge_associative_commutative(self):
+        a = {"requests": 3, "ops": {"add": 1}, "port": "x"}
+        b = {"requests": 5, "ops": {"add": 2, "sample": 7}}
+        c = {"requests": 1, "torn": 4}
+        ab_c = merge_counter_maps(merge_counter_maps(a, b), c)
+        a_bc = merge_counter_maps(a, merge_counter_maps(b, c))
+        assert ab_c == a_bc
+        assert merge_counter_maps(a, b) == merge_counter_maps(b, a)
+        assert ab_c["requests"] == 9
+        assert ab_c["ops"] == {"add": 3, "sample": 7}
+        assert ab_c["port"] == "x"       # non-numeric rides through
+
+    def test_health_merge_freshest_beat_wins(self):
+        import time as _time
+
+        from ape_x_dqn_tpu.obs.registry import Health
+
+        a, b = Health(stale_after_s=100.0), Health(stale_after_s=100.0)
+        a.beat("learner")
+        _time.sleep(0.01)
+        b.beat("learner")
+        b.beat("ingest")
+        fresh_age = b.status()["components"]["learner"]["age_s"]
+        a.merge(b)
+        st = a.status()
+        assert set(st["components"]) == {"learner", "ingest"}
+        # The fresher beat won (merge order must not resurrect staleness).
+        assert st["components"]["learner"]["age_s"] <= fresh_age + 0.05
+        # Commutative: merging the other way yields the same component
+        # ages (modulo clock advance between the two status reads).
+        c = Health(stale_after_s=100.0)
+        c.beat("ingest")
+        c.merge(a)
+        assert set(c.status()["components"]) == {"learner", "ingest"}
+
+    def test_registry_instrument_merges(self):
+        from ape_x_dqn_tpu.obs.registry import Counter, Gauge, Histogram
+
+        c1, c2 = Counter(), Counter()
+        c1.inc(3)
+        c2.inc(4)
+        c1.merge(c2)
+        assert c1.value == 7
+        g1, g2 = Gauge(), Gauge()
+        g1.set(0.4)
+        g2.set(0.9)
+        g1.merge(g2)
+        assert g1.value == 0.9           # conservative max
+        h1, h2 = Histogram(), Histogram()
+        h1.observe(0.01)
+        h2.observe(0.1)
+        h1.merge(h2)
+        assert h1.count == 2
 
 
 class TestRecordStamping:

@@ -93,13 +93,13 @@ def _client_for(srv, **kw):
 
 
 def _raw_conn(srv, incarnation=None, token=None, codec=CODEC_ZLIB,
-              client_id=9):
+              client_id=9, flags=0):
     """Handshake a raw socket (returns it past the ack)."""
     s = socket.create_connection(("127.0.0.1", srv.port), timeout=5.0)
     s.sendall(RSVC_HELLO.pack(
         RSVC_MAGIC, RSVC_VERSION, client_id, srv.shard_id,
         srv.incarnation if incarnation is None else incarnation,
-        srv.token if token is None else token, codec,
+        srv.token if token is None else token, codec, flags,
     ))
     s.settimeout(5.0)
     ack = b""

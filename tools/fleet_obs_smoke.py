@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""Fleet-observability smoke gate (tools/verify_t1.sh gate 12).
+
+The fleet-wide observability plane end to end, CI-sized, on real
+processes:
+
+  1. a 2-shard ReplayServiceFleet comes up (auto-respawn on), and a
+     trainer attaches over the replay RPC plane with process actors and
+     full tracing (``obs.trace_sample_rate=1.0``) + an ephemeral obs
+     exporter;
+  2. a 2-replica ServingFleet comes up behind the router (real serve.py
+     children on the delta param hub) and takes a small client burst so
+     replicas have latency histograms to merge;
+  3. a FleetAggregator discovers all five endpoints (trainer /varz,
+     2 shards via the endpoints file + stats RPC, 2 replicas via their
+     announced obs ports), scrapes on a cadence, and serves the rollup;
+     the smoke asserts the rollup merges histograms from BOTH shards and
+     BOTH replicas with per-endpoint liveness, and that at least one
+     end-to-end trace timeline spans >= 3 distinct pids across an RPC
+     hop (worker act span -> trainer add-RPC client span -> shard server
+     span);
+  4. one shard is SIGKILLed mid-run: the SLO engine's endpoint-liveness
+     rule must fire a damped ``slo_breach`` (burn-rate window, not one
+     bad scrape), the fleet must respawn the shard, the aggregator must
+     re-resolve it through the republished endpoints file, and
+     ``slo_clear`` must follow — the exact breach/clear pair the elastic
+     autopilot will actuate on;
+  5. the committed artifact (``demos/fleet_obs.json``) carries the
+     rollup snapshot, the multi-pid timeline, the breach/clear events,
+     and an ``obs_top --fleet`` rendered frame.
+
+    python tools/fleet_obs_smoke.py [--out demos/fleet_obs.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OBS = (6,)
+CAPACITY = 4096
+
+
+def _tail_jsonl(path):
+    recs = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return recs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleet_obs_smoke")
+    ap.add_argument("--out", default="-")
+    ap.add_argument("--deadline", type=float, default=420.0)
+    args = ap.parse_args(argv)
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from ape_x_dqn_tpu.config import ApexConfig, apply_overrides
+    from ape_x_dqn_tpu.obs.fleet import FleetAggregator, SloEngine, SloRule
+    from ape_x_dqn_tpu.obs.fleet import _endpoints_down
+    from ape_x_dqn_tpu.replay.service import ReplayServiceFleet
+    from ape_x_dqn_tpu.runtime.components import build_components
+    from ape_x_dqn_tpu.serving import ServingClient, ServingFleet
+    from tools.obs_top import render_fleet
+
+    t_start = time.monotonic()
+
+    def remaining() -> float:
+        return args.deadline - (time.monotonic() - t_start)
+
+    tmp = tempfile.mkdtemp(prefix="fleet-obs-smoke-")
+    trainer_log = os.path.join(tmp, "trainer.jsonl")
+    verdict = {"ok": False}
+    slo_events: list = []
+    trainer = None
+    replay_fleet = None
+    serving_fleet = None
+    agg = None
+    try:
+        # -- 1. serving fleet first (replicas pay a jax import each; boot
+        # them before the trainer is burning the same cores).  The first
+        # publish lands BEFORE start: a replica blocks on its initial
+        # param sync before announcing ports (the hub serves the stored
+        # snapshot to fresh connections).
+        cfg = apply_overrides(ApexConfig(), [
+            "network=mlp", "env.name=chain:6", "serving.max_wait_ms=2.0",
+        ])
+        comps = build_components(cfg)
+        serving_fleet = ServingFleet(
+            replicas=2, probe_interval_s=0.5,
+            replica_args=["--set", "network=mlp",
+                          "--set", "env.name=chain:6"],
+        )
+        serving_fleet.publish(comps.state.params)
+        serving_fleet.start(timeout=min(240.0, remaining()))
+        # Burst over MANY connections: the router balances at connection
+        # granularity, so per-connection clients spread the load and BOTH
+        # replicas end up with latency buckets for the rollup to merge.
+        obs0 = np.zeros(comps.obs_shape, np.uint8)
+        served = 0
+        for c in range(8):
+            client = ServingClient("127.0.0.1", serving_fleet.port, seed=c)
+            for _ in range(5):
+                try:
+                    client.act(obs0, timeout=10.0)
+                    served += 1
+                except Exception:  # noqa: BLE001 — a shed under warmup is fine; the count gates below
+                    pass
+            client.close()
+
+        # -- 2. replay fleet + attached trainer.  The respawn backoff is
+        # deliberately SLOW for a shard (seconds, not the sub-second
+        # numpy spawn): the SLO drill below needs a real outage window —
+        # a shard that resurrects inside one scrape tick never
+        # accumulates burn, which is the damping WORKING, not a breach.
+        replay_fleet = ReplayServiceFleet(
+            2, CAPACITY, OBS, root_dir=os.path.join(tmp, "replay"),
+            save_every_s=1.0, respawn_base_s=5.0, respawn_max_s=8.0,
+        ).start(timeout=min(60.0, remaining()))
+        env = {**os.environ, "PYTHONPATH": REPO}
+        trainer = subprocess.Popen(
+            [sys.executable, "-m", "ape_x_dqn_tpu", "--steps", "200000",
+             "--log-every", "50", "--metrics-file", trainer_log,
+             "--set", "network=mlp", "--set", "env.name=chain:6",
+             "--set", f"replay.capacity={CAPACITY}",
+             "--set", "replay.service_mode=attach",
+             "--set",
+             f"replay.service_endpoints={replay_fleet.endpoints_path}",
+             "--set", "replay.service_probe_interval_s=0.25",
+             "--set", "replay.service_request_timeout_s=3.0",
+             "--set", "learner.min_replay_mem_size=400",
+             "--set", "learner.total_steps=200000",
+             "--set", "actor.T=100000000",
+             "--set", "actor.mode=process", "--set", "actor.num_workers=1",
+             "--set", "actor.num_actors=2",
+             "--set", "obs.export_port=0",
+             "--set", "obs.trace_sample_rate=1.0"],
+            cwd=REPO, env=env, stdout=subprocess.DEVNULL,
+            stderr=open(os.path.join(tmp, "trainer.err"), "wb"),
+        )
+
+        def wait_for(cond, timeout, what):
+            deadline = time.monotonic() + min(timeout, max(1.0, remaining()))
+            while time.monotonic() < deadline:
+                if cond():
+                    return
+                if trainer.poll() is not None:
+                    raise RuntimeError(
+                        f"trainer exited rc={trainer.returncode} while "
+                        f"waiting for {what}"
+                    )
+                time.sleep(0.25)
+            raise TimeoutError(f"timed out waiting for {what}")
+
+        def trainer_obs_port():
+            for r in _tail_jsonl(trainer_log):
+                if r.get("event") == "obs_exporter":
+                    return int(r["port"])
+            return None
+
+        wait_for(lambda: trainer_obs_port() is not None, 240.0,
+                 "trainer obs exporter announce")
+
+        # -- 3. the aggregator over all five endpoints ---------------------
+        slo = SloEngine(
+            [SloRule("endpoints_alive", "upper", 0.0, _endpoints_down)],
+            window_s=8.0, burn_threshold=0.4, clear_threshold=0.15,
+            min_samples=3,
+        )
+        agg = FleetAggregator(
+            scrape_interval_s=0.3, scrape_timeout_s=1.5, slo=slo,
+            emit=lambda name, **f: slo_events.append(
+                {"event": name, "t": round(time.monotonic() - t_start, 2),
+                 **f}
+            ),
+        )
+        agg.add_varz("trainer0",
+                     f"http://127.0.0.1:{trainer_obs_port()}/varz",
+                     kind="trainer")
+        for rid, rep in serving_fleet.replicas.items():
+            agg.add_varz(f"replica{rid}",
+                         f"http://127.0.0.1:{rep.obs_port}/varz",
+                         kind="replica")
+        agg.watch_replay_endpoints(replay_fleet.endpoints_path)
+        agg.serve(port=0)
+        agg.start()
+
+        def rollup():
+            return agg.rollup()
+
+        wait_for(
+            lambda: rollup().get("alive", 0) == 5, 120.0,
+            "all five endpoints scraped alive",
+        )
+        wait_for(
+            lambda: (rollup().get("age_of_experience") or {})
+            .get("count", 0) > 0, 180.0,
+            "merged age-of-experience histogram",
+        )
+        wait_for(
+            lambda: any(
+                len(t.get("pids", [])) >= 3
+                for t in rollup().get("traces", [])
+            ), 180.0,
+            "a >=3-pid cross-tier trace timeline",
+        )
+        healthy = rollup()
+        multi_pid_trace = next(
+            t for t in healthy["traces"] if len(t["pids"]) >= 3
+        )
+
+        # -- 4. SIGKILL one shard: breach -> respawn -> clear --------------
+        kill_rec = replay_fleet.kill_random()
+        victim = kill_rec["shard"]
+        wait_for(
+            lambda: any(e["event"] == "slo_breach" for e in slo_events),
+            60.0, "slo_breach after the shard kill",
+        )
+        wait_for(
+            lambda: replay_fleet.shards[victim].alive(), 60.0,
+            "shard respawn",
+        )
+        wait_for(
+            lambda: any(e["event"] == "slo_clear" for e in slo_events),
+            90.0, "slo_clear after recovery",
+        )
+        final = rollup()
+
+        # -- 5. verdict + artifact ----------------------------------------
+        shard_eps = {n: e for n, e in healthy["endpoints"].items()
+                     if e["kind"] == "shard"}
+        replica_eps = {n: e for n, e in healthy["endpoints"].items()
+                       if e["kind"] == "replica"}
+        breach = next(e for e in slo_events if e["event"] == "slo_breach")
+        clear = next(e for e in slo_events if e["event"] == "slo_clear")
+        checks = {
+            "five_endpoints_alive": healthy["alive"] == 5,
+            "two_shards_in_rollup": len(shard_eps) == 2
+            and all(e["alive"] for e in shard_eps.values()),
+            "two_replicas_in_rollup": len(replica_eps) == 2
+            and all(e["alive"] for e in replica_eps.values()),
+            # Merged histograms: shard op_ms buckets from BOTH shards
+            # (requests spread over both), replica latency buckets from
+            # the burst through the router.
+            "shard_histograms_merged": bool(
+                healthy["replay"]["op_buckets"]
+                and healthy["replay"]["shards_alive"] == 2
+                # BOTH shards served requests into the merged histogram.
+                and all((e["detail"] or {}).get("requests", 0) > 0
+                        for e in shard_eps.values())
+            ),
+            "replica_histograms_merged": (
+                healthy["serving"]["count"] >= served > 0
+                and bool(healthy["serving"]["latency_buckets"])
+                # BOTH replicas contributed requests to the merge.
+                and all((e["detail"] or {}).get("requests", 0) > 0
+                        for e in replica_eps.values())
+            ),
+            "age_histogram_merged": healthy["age_of_experience"]["count"] > 0,
+            "trace_spans_three_pids": len(multi_pid_trace["pids"]) >= 3,
+            "trace_crosses_rpc_hop": any(
+                h.startswith("rsvc.") for h in multi_pid_trace["hops"]
+            ),
+            "slo_breach_fired": breach["rule"] == "endpoints_alive",
+            "shard_respawned": replay_fleet.respawns >= 1,
+            "slo_clear_followed": clear["t"] > breach["t"],
+            "rollup_alive_through_outage": agg.sweeps > 0
+            and final["alive"] >= 4,
+        }
+        verdict = {
+            "ok": all(checks.values()),
+            "checks": checks,
+            "kill": kill_rec,
+            "slo_events": slo_events,
+            "rollup": {
+                k: healthy[k] for k in (
+                    "endpoints", "alive", "expected", "scrapes",
+                    "scrape_failures", "age_of_experience", "serving",
+                    "replay", "inference", "ring_occupancy_max",
+                )
+            },
+            "trace_timeline": multi_pid_trace,
+            "rollup_after_recovery": {
+                k: final[k] for k in ("alive", "expected",
+                                      "scrape_failures")
+            },
+            "slo_status": agg.slo_status(),
+            "rendered": render_fleet(
+                {"fleet": healthy, "slo": agg.slo_status()}
+            ).splitlines(),
+            "served_burst": served,
+            "elapsed_s": round(time.monotonic() - t_start, 1),
+        }
+    except (TimeoutError, RuntimeError) as e:
+        verdict = {"ok": False, "error": f"{type(e).__name__}: {e}",
+                   "slo_events": slo_events,
+                   "rollup": agg.rollup() if agg is not None else None,
+                   "elapsed_s": round(time.monotonic() - t_start, 1)}
+        try:
+            with open(os.path.join(tmp, "trainer.err")) as f:
+                tail = f.read()[-1500:]
+            if tail.strip():
+                verdict["trainer_stderr"] = tail
+        except OSError:
+            pass
+    finally:
+        if agg is not None:
+            agg.close()
+        if trainer is not None and trainer.poll() is None:
+            trainer.terminate()
+            try:
+                trainer.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                trainer.kill()
+        if serving_fleet is not None:
+            serving_fleet.stop()
+        if replay_fleet is not None:
+            replay_fleet.stop()
+
+    line = json.dumps(verdict)
+    if args.out == "-":
+        print(line)
+    else:
+        with open(args.out, "w") as f:
+            json.dump(verdict, f, indent=1)
+        print(line[:600])
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

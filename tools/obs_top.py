@@ -6,7 +6,11 @@ Two data sources, one renderer:
     ``obs.export_port`` or serve.py's ``--obs-port``) on an interval;
   * ``--jsonl PATH`` — tail a metrics JSONL file (a live run's
     ``--metrics-file``, or a committed demo artifact) and render its
-    newest periodic record.
+    newest periodic record;
+  * ``--fleet URL`` — scrape a FleetAggregator's rollup /varz
+    (obs/fleet.py) and render the whole fleet: per-shard / per-replica /
+    per-host rows (alive, p95s, occupancy), merged histograms, SLO rule
+    states, and recent cross-tier trace timelines.
 
 Shows the fleet in one screen: learner throughput, per-worker actor
 stats (env-steps/s, ε slice, ring backlog, heartbeat age — the shm
@@ -82,6 +86,99 @@ def _fmt_age(edge: str) -> str:
     if edge == "+Inf":
         return "   +Inf"
     return f"{float(edge):7.3g}"
+
+
+def _num(v, fmt: str = "{:.1f}", dash: str = "-") -> str:
+    if v is None:
+        return dash
+    try:
+        return fmt.format(float(v))
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def render_fleet(snap: dict) -> str:
+    """One fleet frame from a FleetAggregator /varz snapshot: endpoint
+    rows by kind, the merged rollup line, SLO rule states, and the
+    newest cross-tier trace timelines."""
+    fleet = snap.get("fleet") or {}
+    slo = snap.get("slo") or {}
+    eps = fleet.get("endpoints") or {}
+    breaching = slo.get("breaching") or []
+    lines = [
+        "== apex-tpu fleet ==  "
+        f"{fleet.get('alive', 0)}/{fleet.get('expected', 0)} endpoints up  "
+        f"scrapes {fleet.get('scrapes', 0)} "
+        f"({fleet.get('scrape_failures', 0)} failed)  "
+        f"SLO {'BREACH[' + ','.join(breaching) + ']' if breaching else 'ok'}"
+    ]
+    age = fleet.get("age_of_experience") or {}
+    srv = fleet.get("serving") or {}
+    inf = fleet.get("inference") or {}
+    rep = fleet.get("replay") or {}
+    occ = fleet.get("ring_occupancy_max")
+    lines.append(
+        f"-- merged: age p95 {_num(age.get('p95_s'), '{:.2f}')}s "
+        f"(n={age.get('count', 0)})  "
+        f"serving p99 {_num(srv.get('p99_ms'))} ms "
+        f"qps {_num(srv.get('qps'))}  "
+        f"inference rtt p99 {_num(inf.get('rtt_p99_ms_max'))} ms  "
+        f"replay op p95 {_num(rep.get('op_p95_ms'), '{:.2f}')} ms  "
+        f"ring occ {_num(occ, '{:.3f}')}"
+    )
+    for kind, title in (("trainer", "hosts/trainers"), ("shard", "shards"),
+                        ("replica", "replicas"), ("host", "hosts")):
+        rows = {n: e for n, e in eps.items() if e.get("kind") == kind}
+        if not rows:
+            continue
+        lines.append(f"-- {title} ({len(rows)}) " + "-" * 40)
+        for name in sorted(rows):
+            e = rows[name]
+            d = e.get("detail") or {}
+            if kind == "shard":
+                extra = (f"size {d.get('size', '-'):>8}  "
+                         f"req {d.get('requests', '-'):>7}  "
+                         f"p95 {_num(d.get('p95_ms'), '{:.2f}'):>8} ms  "
+                         f"inc {d.get('incarnation', '-')}")
+            elif kind == "replica":
+                extra = (f"req {d.get('requests', '-'):>7}  "
+                         f"p95 {_num(d.get('p95_ms'), '{:.2f}'):>8} ms  "
+                         f"shed {d.get('shed', '-')}  "
+                         f"v{d.get('param_version', '?')}")
+            else:
+                extra = (f"step {d.get('step', '-'):>8}  "
+                         f"{_num(d.get('steps_per_sec')):>8} steps/s  "
+                         f"workers {d.get('workers', '-')}  "
+                         f"age p95 {_num(d.get('age_p95_ms'))} ms")
+            lines.append(
+                f" {name:<16} {'up  ' if e.get('alive') else 'DOWN':<5}"
+                f"fails {e.get('scrape_failures', 0):>4}  " + extra
+            )
+    rules = (slo.get("rules") or {})
+    if rules:
+        lines.append(f"-- slo rules ({len(rules)}) " + "-" * 40)
+        for name in sorted(rules):
+            r = rules[name]
+            lines.append(
+                f" {name:<24} {r.get('state', '?'):<7}"
+                f"value {_num(r.get('value'), '{:.3f}'):>10}  "
+                f"{'<=' if r.get('kind') == 'upper' else '>='} "
+                f"{_num(r.get('bound'), '{:.3f}')}  "
+                f"burn {_num(r.get('burn'), '{:.2f}')} "
+                f"({r.get('samples', 0)} samples)  "
+                f"b/c {r.get('breaches', 0)}/{r.get('clears', 0)}"
+            )
+    traces = fleet.get("traces") or []
+    if traces:
+        lines.append(f"-- traces ({len(traces)} recent timelines) " + "-" * 24)
+        for t in traces[:4]:
+            hops = " -> ".join(
+                f"{s.get('hop')}@{s.get('pid')}"
+                f"({_num(s.get('dur_ms'), '{:.1f}')}ms)"
+                for s in t.get("spans", [])
+            )
+            lines.append(f" {t.get('trace_id')}: {hops}")
+    return "\n".join(lines)
 
 
 def render(snap: dict) -> str:
@@ -233,6 +330,9 @@ def main(argv=None) -> int:
                      help="exporter base URL or full /varz URL")
     src.add_argument("--jsonl", metavar="PATH",
                      help="metrics JSONL file to tail")
+    src.add_argument("--fleet", metavar="URL",
+                     help="FleetAggregator rollup URL (obs/fleet.py) — "
+                     "renders per-shard/replica/host rows + SLO states")
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit")
@@ -245,12 +345,14 @@ def main(argv=None) -> int:
     def grab() -> dict:
         if args.varz:
             return snapshot_from_varz(args.varz)
+        if args.fleet:
+            return snapshot_from_varz(args.fleet)
         return snapshot_from_jsonl(args.jsonl)
 
     while True:
         try:
             snap = grab()
-            frame = render(snap)
+            frame = render_fleet(snap) if args.fleet else render(snap)
         except Exception as e:  # noqa: BLE001 — a scrape gap, keep going
             snap, frame = {}, f"(no data: {type(e).__name__}: {e})"
         if not args.plain and not args.once:
