@@ -216,6 +216,15 @@ class ActorConfig:
     # allocation).  The supervisor's exponential backoff layers ON TOP of
     # this floor; 0 restores the old immediate-respawn behavior.
     respawn_min_interval_s: float = 0.25
+    # Elastic headroom for the process pool (autopilot/ scale-up).  The
+    # global ε-ladder partition is carved over max(num_workers,
+    # max_workers) local wids AT CONSTRUCTION, so a worker grown
+    # post-start claims a fresh wid whose actor slice was reserved from
+    # step zero — growing never reshuffles a running worker's slice.
+    # Only num_workers spawn at start; ProcessActorPool.grow() activates
+    # the reserved wids on demand.  0 = num_workers (no headroom, the
+    # pre-elastic layout bit-for-bit).
+    max_workers: int = 0
 
 
 @dataclasses.dataclass
@@ -568,6 +577,85 @@ class SupervisorConfig:
 
 
 @dataclasses.dataclass
+class AutopilotConfig:
+    """Elastic capacity controller (ape_x_dqn_tpu/autopilot/).  Default OFF.
+
+    The actuation half of ROADMAP item 3: one controller, two loops —
+    (a) actor fleet: grow/retire worker processes (and tune the drain
+    budget / pipeline depth) to hold age-of-experience p95 under its
+    bound and ring occupancy in band; (b) serving fleet: grow/retire
+    replicas against the QPS-floor / p99 SLOs.  Decisions consume the
+    SLO engine's damped ``slo_breach``/``slo_clear`` events
+    (``obs.fleet_slo_*``) plus the fleet rollup, and every one passes
+    the shared guardrails (min/max bounds, per-direction cooldowns, a
+    hold window against the opposite direction, one step at a time), so
+    a flapping signal can never oscillate capacity.
+    """
+
+    enabled: bool = False
+    # Log every decision as an ``autopilot_action`` event WITHOUT
+    # actuating — the rehearsal mode for tuning bounds against a live
+    # fleet before handing it the keys.
+    dry_run: bool = False
+    # Decision cadence (the controller's own thread).
+    poll_s: float = 1.0
+    # Actor-fleet floor; the ceiling is the pool's reserved capacity
+    # (max(actor.num_workers, actor.max_workers)).
+    actor_min_workers: int = 1
+    # Serving-fleet bounds (replica count the controller may move
+    # between; scale-down drains from rotation first, then SIGTERM).
+    serving_min_replicas: int = 1
+    serving_max_replicas: int = 4
+    # Per-direction cooldowns: after a scale action, the SAME direction
+    # waits this long before acting again (a booting replica/worker must
+    # get a chance to move the metric before the next step).
+    cooldown_up_s: float = 10.0
+    cooldown_down_s: float = 60.0
+    # Flap damper on top of the SLO engine's burn-window hysteresis:
+    # after ANY action, the OPPOSITE direction additionally waits this
+    # long — an up-down-up oscillation needs at least this period.
+    hold_opposite_s: float = 30.0
+    # Idle scale-down rule for the serving loop: replicas step down
+    # (toward the floor) only while the fleet's per-replica QPS has sat
+    # under this bound for the idle burn window AND every governing SLO
+    # is green.  0 disables — replicas then only ever scale up.
+    serving_idle_qps_per_replica: float = 0.0
+    # Burn window for the idle (scale-down) rules — evaluated on the
+    # controller's own SloEngine, so scale-down inherits the same
+    # damping discipline as the breach-driven scale-up.
+    idle_window_s: float = 30.0
+    # Drain-budget tuning ladder (actor loop, ring-occupancy-high
+    # breach): the pool's per-poll drain budget is doubled per action up
+    # to this multiple of its configured value BEFORE any worker is
+    # retired — drain harder first, shrink the fleet last.
+    drain_tune_max_factor: float = 4.0
+
+    def validate_section(self) -> list:
+        return [
+            (self.poll_s > 0.0, "autopilot.poll_s must be > 0"),
+            (self.actor_min_workers >= 1,
+             "autopilot.actor_min_workers must be >= 1"),
+            (self.serving_min_replicas >= 1,
+             "autopilot.serving_min_replicas must be >= 1"),
+            (self.serving_max_replicas >= self.serving_min_replicas,
+             "autopilot.serving_max_replicas must be >= "
+             "autopilot.serving_min_replicas"),
+            (self.cooldown_up_s >= 0.0,
+             "autopilot.cooldown_up_s must be >= 0"),
+            (self.cooldown_down_s >= 0.0,
+             "autopilot.cooldown_down_s must be >= 0"),
+            (self.hold_opposite_s >= 0.0,
+             "autopilot.hold_opposite_s must be >= 0"),
+            (self.serving_idle_qps_per_replica >= 0.0,
+             "autopilot.serving_idle_qps_per_replica must be >= 0"),
+            (self.idle_window_s > 0.0,
+             "autopilot.idle_window_s must be > 0"),
+            (self.drain_tune_max_factor >= 1.0,
+             "autopilot.drain_tune_max_factor must be >= 1"),
+        ]
+
+
+@dataclasses.dataclass
 class ChaosConfig:
     """Deterministic fault injection (obs/chaos.py).  Default OFF.
 
@@ -599,6 +687,14 @@ class ChaosConfig:
     # Per-env-step latency injected inside worker processes (mean ms,
     # seeded jitter) — the slow-env scenario.
     env_latency_ms: float = 0.0
+    # Per-batch service latency injected inside the serving tier's apply
+    # path (mean ms, seeded +/-25% jitter; serving/server.PolicyServer).
+    # The serving twin of env_latency_ms: it makes replica service time
+    # SLEEP-bound, so a 1-core CI host can exercise real capacity
+    # scaling (replicas sleeping concurrently genuinely multiply
+    # throughput) — the disturbance the autopilot smoke drives its
+    # serving loop with.
+    serving_delay_ms: float = 0.0
     # --- RPC-plane chaos (replay/service.py shards) ---
     # Mean per-request service delay (ms, seeded +/-50% jitter) injected
     # shard-side before the request executes — the slow-replay scenario
@@ -632,6 +728,7 @@ class ChaosConfig:
         nonneg += [
             ("rpc_delay_ms", self.rpc_delay_ms),
             ("kill_shard_interval_s", self.kill_shard_interval_s),
+            ("serving_delay_ms", self.serving_delay_ms),
         ]
         return [
             (v >= 0.0, f"chaos.{k} must be >= 0") for k, v in nonneg
@@ -654,6 +751,9 @@ class ApexConfig:
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
     supervisor: SupervisorConfig = dataclasses.field(
         default_factory=SupervisorConfig
+    )
+    autopilot: AutopilotConfig = dataclasses.field(
+        default_factory=AutopilotConfig
     )
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
     network: str = "conv"                 # "conv" | "nature" | "mlp"
@@ -779,9 +879,21 @@ class ApexConfig:
             (s.param_tail_base_every >= 1,
              "serving.param_tail_base_every must be >= 1"),
             *self.supervisor.validate_section(),
+            *self.autopilot.validate_section(),
             *self.chaos.validate_section(),
             (a.mode != "process" or a.num_actors >= a.num_workers,
              "actor.num_actors must be >= actor.num_workers in process mode"),
+            (a.max_workers == 0 or a.max_workers >= a.num_workers,
+             "actor.max_workers must be 0 (no headroom) or >= "
+             "actor.num_workers (the spawned width is part of the "
+             "reserved partition)"),
+            (a.max_workers == 0 or a.mode == "process",
+             "actor.max_workers requires actor.mode=process (the elastic "
+             "pool is the process fleet)"),
+            (a.mode != "process"
+             or a.num_actors >= max(a.num_workers, a.max_workers),
+             "actor.num_actors must cover the reserved worker capacity "
+             "(max(num_workers, max_workers)) in process mode"),
             (l.publish_every >= 1, "learner.publish_every must be >= 1"),
             (l.checkpoint_base_every >= 1,
              "learner.checkpoint_base_every must be >= 1"),
@@ -857,9 +969,10 @@ class ApexConfig:
              "actor.remote_workers > 0 requires actor.remote_join_path "
              "(where the join spec for tools/host_join.py lands)"),
             (a.mode != "process"
-             or a.num_actors >= a.num_workers + a.remote_workers,
-             "actor.num_actors must cover local + remote workers in "
-             "process mode"),
+             or a.num_actors
+             >= max(a.num_workers, a.max_workers) + a.remote_workers,
+             "actor.num_actors must cover local (incl. max_workers "
+             "headroom) + remote workers in process mode"),
             (0.0 <= r.is_exponent <= 1.0, "replay.is_exponent must be in [0, 1]"),
             (self.network in ("conv", "nature", "mlp"),
              f"unknown network kind: {self.network}"),
@@ -1026,7 +1139,8 @@ def _from_native_json(data: dict) -> ApexConfig:
         "env": EnvConfig, "actor": ActorConfig,
         "learner": LearnerConfig, "replay": ReplayConfig,
         "serving": ServingConfig, "obs": ObsConfig,
-        "supervisor": SupervisorConfig, "chaos": ChaosConfig,
+        "supervisor": SupervisorConfig, "autopilot": AutopilotConfig,
+        "chaos": ChaosConfig,
     }
     for key, value in data.items():
         if key in sections:

@@ -131,13 +131,22 @@ class SloEngine:
         self.clear_threshold = float(clear_threshold)
         self.min_samples = int(min_samples)
         self._emit = emit              # callable(event_name, **fields)
+        self._subscribers: List[Callable] = []
         self.breaches = 0
         self.clears = 0
 
+    def subscribe(self, fn: Callable[..., None]) -> None:
+        """Register an event listener called as ``fn(name, **fields)`` on
+        every ``slo_breach``/``slo_clear`` in addition to the emit sink —
+        the actuation hook the autopilot controller consumes (a listener
+        that raises is isolated; evaluation never stops)."""
+        self._subscribers.append(fn)
+
     def _event(self, name: str, **fields) -> None:
-        if self._emit is not None:
+        for fn in ((self._emit,) if self._emit is not None else ()) \
+                + tuple(self._subscribers):
             try:
-                self._emit(name, **fields)
+                fn(name, **fields)
             except Exception:  # noqa: BLE001 — telemetry must not stop evaluation
                 pass
 
@@ -214,11 +223,64 @@ class SloEngine:
         }
 
 
+class _BucketWindow:
+    """Windowed percentiles over CUMULATIVE bucket dicts.
+
+    The lineage age histogram and the replica latency histograms are
+    cumulative for their process's lifetime, so their percentiles barely
+    move once a run has history — a capacity action that fixed the
+    CURRENT distribution would never show on them.  Feed each sweep's
+    merged cumulative buckets here: per-edge deltas vs the previous feed
+    accumulate in a trailing deque, and ``percentile`` re-derives from
+    the window's summed deltas — the distribution of the LAST
+    ``window_s`` seconds only.  Negative deltas (an endpoint respawned
+    and its counters reset, or dropped out of the merge) clamp to zero:
+    a reset loses at most one endpoint's window contribution, never
+    corrupts the sum."""
+
+    def __init__(self, window_s: float = 30.0):
+        self.window_s = float(window_s)
+        self._prev: dict = {}
+        self._deltas: deque = deque()   # (t, {edge: count_delta})
+
+    def feed(self, buckets: dict, now: float) -> None:
+        delta = {
+            k: max(0, int(v) - int(self._prev.get(k, 0)))
+            for k, v in (buckets or {}).items()
+        }
+        self._prev = dict(buckets or {})
+        if any(delta.values()):
+            self._deltas.append((now, delta))
+        cutoff = now - self.window_s
+        while self._deltas and self._deltas[0][0] < cutoff:
+            self._deltas.popleft()
+
+    def merged(self) -> dict:
+        out: dict = {}
+        for _, d in self._deltas:
+            out = merge_bucket_dicts(out, d)
+        return out
+
+    def count(self) -> int:
+        return sum(sum(d.values()) for _, d in self._deltas)
+
+    def percentile(self, q: float) -> Optional[float]:
+        m = self.merged()
+        if not any(m.values()):
+            return None
+        return bucket_percentile(m, q)
+
+
 # -- rollup metric extractors (the rule vocabulary) -------------------------
 
 
 def _age_p95_ms(rollup: dict) -> Optional[float]:
     age = rollup.get("age_of_experience") or {}
+    win = age.get("window") or {}
+    if win.get("count"):
+        # Windowed value when the aggregator computes one: the SLO must
+        # see the CURRENT distribution, not the run's whole history.
+        return win.get("p95_s", 0.0) * 1e3
     if not age.get("count"):
         return None
     return age.get("p95_s", 0.0) * 1e3
@@ -231,6 +293,9 @@ def _inference_rtt_p99_ms(rollup: dict) -> Optional[float]:
 
 def _serving_p99_ms(rollup: dict) -> Optional[float]:
     srv = rollup.get("serving") or {}
+    win = srv.get("window") or {}
+    if win.get("count"):
+        return win.get("p99_ms")
     if not srv.get("count"):
         return None
     return srv.get("p99_ms")
@@ -305,16 +370,17 @@ def engine_from_config(obs_cfg, emit=None) -> SloEngine:
 
 
 class _Endpoint:
-    __slots__ = ("name", "kind", "url", "shard_spec", "alive",
-                 "scrape_failures", "consecutive_failures", "last_ok_t",
-                 "last_error", "snapshot", "prev_qps_mark")
+    __slots__ = ("name", "kind", "url", "shard_spec", "snapshot_fn",
+                 "alive", "scrape_failures", "consecutive_failures",
+                 "last_ok_t", "last_error", "snapshot", "prev_qps_mark")
 
     def __init__(self, name: str, kind: str, url: Optional[str] = None,
-                 shard_spec: Optional[dict] = None):
+                 shard_spec: Optional[dict] = None, snapshot_fn=None):
         self.name = name
         self.kind = kind               # trainer | replica | shard | host
         self.url = url                 # /varz base for HTTP endpoints
         self.shard_spec = shard_spec   # {host, port, token, id, incarnation}
+        self.snapshot_fn = snapshot_fn  # in-process /varz twin (add_local)
         self.alive = False
         self.scrape_failures = 0
         self.consecutive_failures = 0
@@ -334,7 +400,8 @@ class _Endpoint:
             "last_error": self.last_error,
             "addr": self.url or (
                 f"{self.shard_spec['host']}:{self.shard_spec['port']}"
-                if self.shard_spec else None
+                if self.shard_spec
+                else ("local" if self.snapshot_fn is not None else None)
             ),
         }
 
@@ -375,9 +442,14 @@ class FleetAggregator:
     def __init__(self, *, scrape_interval_s: float = 1.0,
                  scrape_timeout_s: float = 2.0,
                  slo: Optional[SloEngine] = None,
+                 window_s: float = 30.0,
                  emit=None, jsonl_stream=None):
         self._interval = float(scrape_interval_s)
         self._timeout = float(scrape_timeout_s)
+        # Windowed twins of the cumulative merged histograms (the values
+        # the SLO extractors prefer — see _BucketWindow).
+        self._age_window = _BucketWindow(window_s=window_s)
+        self._serving_window = _BucketWindow(window_s=window_s)
         self._emit = emit if emit is not None else (
             lambda name, **f: emit_event(name, stream=jsonl_stream, **f)
         )
@@ -416,6 +488,22 @@ class FleetAggregator:
                 self._eps[name] = _Endpoint(name, kind, url=base)
             else:
                 ep.url = base
+
+    def add_local(self, name: str, snapshot_fn, kind: str = "trainer") -> None:
+        """Register an IN-PROCESS endpoint: ``snapshot_fn()`` returns the
+        same sectioned dict its /varz would serve (e.g. a registry's
+        ``snapshot``).  How a trainer-hosted aggregator (the autopilot's
+        sensor) reads its own process without an HTTP round trip — the
+        merge arithmetic and liveness accounting are identical."""
+        with self._lock:
+            self._eps[name] = _Endpoint(name, kind, snapshot_fn=snapshot_fn)
+
+    def remove_endpoint(self, name: str) -> None:
+        """Forget one endpoint (a replica retired by the autopilot leaves
+        the fleet ON PURPOSE — keeping it registered would read as a
+        liveness breach)."""
+        with self._lock:
+            self._eps.pop(name, None)
 
     def watch_replay_endpoints(self, path: str) -> None:
         """Discover replay shards from the fleet's endpoints file (the
@@ -486,8 +574,12 @@ class FleetAggregator:
         for ep in eps:
             self.scrapes += 1
             try:
-                snap = (self._scrape_shard(ep) if ep.kind == "shard"
-                        else self._scrape_http(ep))
+                if ep.snapshot_fn is not None:
+                    snap = dict(ep.snapshot_fn())
+                elif ep.kind == "shard":
+                    snap = self._scrape_shard(ep)
+                else:
+                    snap = self._scrape_http(ep)
             except Exception as e:  # noqa: BLE001 — ANY scrape fault = endpoint down, never a sweep crash
                 self.scrape_failures += 1
                 ep.scrape_failures += 1
@@ -590,6 +682,7 @@ class FleetAggregator:
         inference_replies = 0
         ring_occ: List[float] = []
         spans: List[dict] = []
+        autopilot: Optional[dict] = None
         for ep in eps:
             snap = ep.snapshot
             if snap is None:
@@ -607,7 +700,13 @@ class FleetAggregator:
                         {k: snap[k] for k in _SHARD_SUM_KEYS if k in snap},
                     )
                 continue
-            # HTTP endpoints: lineage / inference / serving / workers.
+            # HTTP/local endpoints: lineage / inference / serving /
+            # workers / autopilot.
+            if isinstance(snap.get("autopilot"), dict):
+                # The controller's own state rides its trainer's /varz;
+                # lift the newest live one onto the rollup so obs_top
+                # --fleet renders it next to the SLO states.
+                autopilot = snap["autopilot"]
             lineage = snap.get("lineage") or {}
             age = lineage.get("age_at_sample") or {}
             if age.get("count"):
@@ -647,6 +746,10 @@ class FleetAggregator:
                             / ring_bytes
                         )
         self._fold_traces(spans)
+        self._age_window.feed(age_buckets, now)
+        self._serving_window.feed(serving_buckets, now)
+        age_win_n = self._age_window.count()
+        srv_win_n = self._serving_window.count()
         rollup: dict = {
             "endpoints": {
                 ep.name: {**ep.summary(now), "detail": _endpoint_detail(ep)}
@@ -666,6 +769,15 @@ class FleetAggregator:
                 "p99_s": round(bucket_percentile(age_buckets, 99), 4)
                 if age_count else None,
                 "buckets_s": age_buckets,
+                # Trailing-window distribution (see _BucketWindow): the
+                # value the age SLO rule actually evaluates.
+                "window": {
+                    "count": age_win_n,
+                    "p50_s": round(self._age_window.percentile(50), 4)
+                    if age_win_n else None,
+                    "p95_s": round(self._age_window.percentile(95), 4)
+                    if age_win_n else None,
+                },
             },
             "inference": {
                 "rtt_p99_ms_max": (round(max(inference_p99), 3)
@@ -688,6 +800,15 @@ class FleetAggregator:
                 if serving_count else None,
                 "qps": round(serving_qps, 2),
                 "latency_buckets": serving_buckets,
+                "window": {
+                    "count": srv_win_n,
+                    "p50_ms": round(
+                        self._serving_window.percentile(50) * 1e3, 3)
+                    if srv_win_n else None,
+                    "p99_ms": round(
+                        self._serving_window.percentile(99) * 1e3, 3)
+                    if srv_win_n else None,
+                },
             },
             "replay": {
                 "shards_alive": shards_alive,
@@ -699,6 +820,7 @@ class FleetAggregator:
             },
             "ring_occupancy_max": (round(max(ring_occ), 4)
                                    if ring_occ else None),
+            "autopilot": autopilot,
             "traces": self._timelines(),
         }
         return rollup

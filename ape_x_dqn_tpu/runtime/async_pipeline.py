@@ -829,6 +829,54 @@ class AsyncPipeline:
                 if self.cfg.learner.checkpoint_every else []
             )
             self._chaos.attach(pool=pool, ckpt_dirs=ckpt_dirs)
+        # --- elastic autopilot (autopilot.*; ROADMAP item 3's actuation
+        # loop) -------------------------------------------------------------
+        # The controller needs the sensor layer IN-PROCESS: a
+        # FleetAggregator whose "trainer" endpoint is this registry's own
+        # snapshot (no HTTP round trip; identical merge arithmetic), with
+        # the config-declared SLO rules subscribed straight into the
+        # controller's event queue.  The actor loop actuates on this
+        # process's own pool; a serving fleet is attached by the driver
+        # (``pipe.autopilot.attach_serving(...)`` + replica endpoints on
+        # ``pipe.autopilot_aggregator``) — capacity topology is the
+        # deployment's, not the trainer's.
+        self.autopilot = None
+        self.autopilot_aggregator = None
+        if self.cfg.autopilot.enabled:
+            from ape_x_dqn_tpu.autopilot import (
+                ActorPoolActuator,
+                AutopilotController,
+            )
+            from ape_x_dqn_tpu.obs.fleet import (
+                FleetAggregator,
+                engine_from_config,
+            )
+
+            slo = engine_from_config(self.cfg.obs, emit=self.logger.event)
+            self.autopilot_aggregator = FleetAggregator(
+                scrape_interval_s=self.cfg.obs.fleet_scrape_interval_s,
+                scrape_timeout_s=self.cfg.obs.fleet_scrape_timeout_s,
+                window_s=self.cfg.obs.fleet_slo_window_s,
+                slo=slo,
+            )
+            self.autopilot_aggregator.add_local(
+                "trainer", self.obs_registry.snapshot, kind="trainer"
+            )
+            self.autopilot = AutopilotController(
+                self.cfg.autopilot,
+                rollup_fn=self.autopilot_aggregator.rollup,
+                emit=self.logger.event,
+            )
+            slo.subscribe(self.autopilot.on_slo_event)
+            pool = getattr(self.worker, "pool", None)
+            if pool is not None:
+                self.autopilot.attach_actor(ActorPoolActuator(
+                    pool, pipeline_fn=lambda: self._dispatch_pipeline,
+                ))
+            self.obs_registry.register_provider(
+                "autopilot", self.autopilot.state
+            )
+            self.register_jsonl_section("autopilot", self.autopilot.state)
 
     def _build_central_serving(self) -> None:
         """Resolve the central-inference endpoint: host an in-process
@@ -1621,6 +1669,9 @@ class AsyncPipeline:
             self.supervisor.start()
         if self._chaos is not None:
             self._chaos.start()
+        if self.autopilot is not None:
+            self.autopilot_aggregator.start()
+            self.autopilot.start()
 
     def _obs_fault(self, e: BaseException) -> None:
         """Fault path: one recorded event + a post-mortem dump.  Both are
@@ -1647,6 +1698,16 @@ class AsyncPipeline:
         for sel in self._central_selectors:
             try:
                 sel.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        if self.autopilot is not None:
+            try:
+                self.autopilot.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        if self.autopilot_aggregator is not None:
+            try:
+                self.autopilot_aggregator.close()
             except Exception:  # noqa: BLE001 — teardown best-effort
                 pass
         if self._chaos is not None:

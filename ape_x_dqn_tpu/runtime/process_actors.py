@@ -299,7 +299,7 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                  param_spec: dict, xp_spec: dict, ctl_queue, stop_evt,
                  steps_budget: int, quantum: int, attempt: int = 0,
                  seed_base: int = 0, nice: int = 0,
-                 stats_name: Optional[str] = None):
+                 stats_name: Optional[str] = None, retire_evt=None):
     """Worker process entry: CPU-only jax, one ActorFleet slice, gather
     chunks into this incarnation's transport channel (shm ring or TCP
     connection — ``xp_spec`` names the backend); episode stats /
@@ -506,7 +506,15 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
                         ctl_queue.put(("done", worker_id, 0))
                         return
                     time.sleep(0.01)
-        while not stop_evt.is_set() and fleet.step_count < steps_budget:
+        # Autopilot retirement (pool.retire): a per-incarnation event that
+        # ends the collect loop at the NEXT quantum boundary — the worker
+        # flushes its committed chunks and exits through the clean "done"
+        # path, exactly like an exhausted budget.  Never a SIGKILL.
+        def _retiring() -> bool:
+            return retire_evt is not None and retire_evt.is_set()
+
+        while not stop_evt.is_set() and not _retiring() \
+                and fleet.step_count < steps_budget:
             # Clamp the final quantum: the budget bounds TOTAL fleet steps
             # across incarnations, so the last collect must land exactly.
             t0 = time.monotonic()
@@ -606,7 +614,7 @@ def _worker_main(worker_id: int, cfg_dict: dict, num_workers: int,
             # docstring — measured in the round-5 flagship soak).
             trim_malloc()
         recorder.record("done", steps=fleet.step_count,
-                        stopped=stop_evt.is_set())
+                        stopped=stop_evt.is_set(), retired=_retiring())
         if selector is not None:
             try:
                 ctl_queue.put_nowait((
@@ -680,7 +688,16 @@ class ProcessActorPool:
         # publishes a join spec; it never spawns or supervises them — a
         # quiet remote channel is degradation, not a death.
         self.remote_workers = int(getattr(cfg.actor, "remote_workers", 0))
-        self.total_workers = self.num_workers + self.remote_workers
+        # Elastic headroom (actor.max_workers; autopilot scale-up): the
+        # global ε-ladder partition is carved over local_capacity wids AT
+        # CONSTRUCTION, so a worker grown post-start claims a wid whose
+        # actor slice was reserved from step zero — growth and retirement
+        # never move a running worker's slice.  max_workers=0 keeps the
+        # pre-elastic layout bit-for-bit (capacity == num_workers).
+        self.local_capacity = max(
+            self.num_workers, int(getattr(cfg.actor, "max_workers", 0) or 0)
+        )
+        self.total_workers = self.local_capacity + self.remote_workers
         self._queue_size = int(queue_size)
         self._ring_bytes = int(
             ring_bytes if ring_bytes is not None else cfg.actor.xp_ring_bytes
@@ -766,6 +783,12 @@ class ProcessActorPool:
         # deterministic startup crash must not spin the pool at fork speed.
         self.respawn_policy = None
         self.quarantined: set = set()         # written-off workers
+        # Elastic state (grow/retire — the autopilot's actor actuators).
+        self.retired: set = set()             # cleanly drained wids
+        self._retire_events: dict = {}        # wid -> mp Event (live inc.)
+        self._spawned_local: set = set()      # local wids ever spawned
+        self.grows = 0
+        self.retires = 0
         self._death_pending: dict = {}        # wid -> error, awaiting respawn
         self._last_spawn: dict = {}           # wid -> spawn time
         self._min_respawn_interval = float(cfg.actor.respawn_min_interval_s)
@@ -790,6 +813,8 @@ class ProcessActorPool:
         self._last_spawn[wid] = time.monotonic()
         if wid in self._queues:
             self._salvage_incarnation(wid)
+        self._spawned_local.add(wid)
+        self._retire_events[wid] = self._ctx.Event()
         self._queues[wid] = self._ctx.Queue(maxsize=self._queue_size)
         self._rings[wid] = self._transport.make_channel(wid, attempt)
         xp_spec = self._transport.endpoint(self._rings[wid], wid, attempt)
@@ -816,7 +841,8 @@ class ProcessActorPool:
             args=(wid, self._cfg_dict, self.total_workers, param_spec,
                   xp_spec, self._queues[wid], self.stop_event,
                   budget, self._quantum, attempt, self._seed_base,
-                  self.cfg.actor.worker_nice, stats_name),
+                  self.cfg.actor.worker_nice, stats_name,
+                  self._retire_events[wid]),
             daemon=True,
         )
         p.start()
@@ -975,33 +1001,41 @@ class ProcessActorPool:
         self._worker_snap_t = now
         return out
 
+    def _gate_shm_budget(self, new_rings: int,
+                         include_param_buffer: bool) -> None:
+        """fd/shm budget gate: fail loudly BEFORE spawning workers whose
+        rings cannot fit /dev/shm (256 workers × ring_bytes is real
+        money).  tcp mode allocates no rings — experience bytes live in
+        kernel socket buffers — so only the shm backend gates here.  The
+        SAME arithmetic gates the fleet start and every post-start
+        ``grow`` (one more ring against the live free space)."""
+        import os as _os
+
+        if self._transport.kind != "shm":
+            return
+        need = new_rings * self._ring_bytes + (
+            self.buffer.capacity
+            if include_param_buffer and self.buffer is not None else 0
+        )
+        try:
+            st = _os.statvfs("/dev/shm")
+            free = st.f_bavail * st.f_frsize
+        except OSError:
+            return
+        if need > free:
+            raise RuntimeError(
+                f"experience-transport shm budget {need} bytes exceeds "
+                f"/dev/shm free space {free} — lower actor.xp_ring_bytes "
+                f"or actor.num_workers"
+            )
+
     def start(self, stagger_s: Optional[float] = None):
         """Spawn all workers, optionally throttled (``stagger_s`` seconds
         between spawns — at 256 workers an unthrottled start piles every
         child's jax import onto the host at once)."""
-        import os as _os
-
         stagger = (stagger_s if stagger_s is not None
                    else self.cfg.actor.spawn_stagger_s)
-        # fd/shm budget gate: fail loudly BEFORE spawning a fleet whose
-        # rings cannot fit /dev/shm (256 workers × ring_bytes is real
-        # money).  tcp mode allocates no rings — experience bytes live in
-        # kernel socket buffers — so only the shm backend gates here.
-        if self._transport.kind == "shm":
-            need = self.num_workers * self._ring_bytes + (
-                self.buffer.capacity if self.buffer is not None else 0
-            )
-            try:
-                st = _os.statvfs("/dev/shm")
-                free = st.f_bavail * st.f_frsize
-            except OSError:
-                free = None
-            if free is not None and need > free:
-                raise RuntimeError(
-                    f"experience-transport shm budget {need} bytes exceeds "
-                    f"/dev/shm free space {free} — lower actor.xp_ring_bytes "
-                    f"or actor.num_workers"
-                )
+        self._gate_shm_budget(self.num_workers, include_param_buffer=True)
         for w in range(self.num_workers):
             self._procs.append(self._spawn(w, self.cfg.actor.T))
             if stagger and w + 1 < self.num_workers:
@@ -1031,7 +1065,10 @@ class ProcessActorPool:
             raise RuntimeError("actor.remote_join_path is empty")
         specs = []
         for k in range(self.remote_workers):
-            wid = self.num_workers + k
+            # Remote wids sit ABOVE the whole local capacity (spawned +
+            # growable), so elastic growth never collides with a slice a
+            # remote host already claimed.
+            wid = self.local_capacity + k
             if wid not in self._rings:
                 self._attempt[wid] = 1   # attempt 0 is the joinable one
                 self._rings[wid] = self._transport.make_channel(wid, 0)
@@ -1055,6 +1092,112 @@ class ProcessActorPool:
         os.replace(tmp, path)
         return path
 
+    # -- elastic grow/retire (the autopilot's actor-fleet actuators) -------
+
+    def live_workers(self) -> List[int]:
+        """Local wids currently contributing capacity: spawned, not
+        retired, not quarantined, not finished/fatal (a booting respawn
+        still counts — its slice is claimed)."""
+        # Frozen copies: the autopilot thread reads this while the pump
+        # thread mutates the sets (CPython set iteration is not safe
+        # against concurrent adds).
+        spawned = set(self._spawned_local)
+        out = set(self.retired) | set(self.quarantined) \
+            | set(self.worker_errors) | set(self.finished_workers)
+        return sorted(spawned - out)
+
+    def grow_candidates(self) -> List[int]:
+        """Reserved local wids a ``grow`` could activate right now:
+        never-spawned headroom plus cleanly-retired wids (fresh
+        incarnation, SAME ε-ladder slice) — quarantined and fatal wids
+        stay written off."""
+        live = set(self.live_workers())
+
+        def _settled(w: int) -> bool:
+            # A retiring wid is reusable only once its old incarnation
+            # fully drained: process exited AND ring/queue reclaimed by
+            # the supervise sweep — never spawn over a live drain.
+            if w < len(self._procs) and self._procs[w].is_alive():
+                return False
+            return w not in self._rings and w not in self._queues
+
+        return sorted(
+            w for w in range(self.local_capacity)
+            if w not in live and w not in self.quarantined
+            and w not in self.worker_errors and _settled(w)
+            and max(0, self.cfg.actor.T - self._steps_by_worker.get(w, 0))
+            > 0
+        )
+
+    def grow(self, n: int = 1, stagger_s: Optional[float] = None
+             ) -> List[int]:
+        """Activate up to ``n`` reserved wids post-start: the SAME spawn
+        path as ``start()`` (fresh ring + stats block, remaining-budget
+        arithmetic, stagger between spawns, /dev/shm gate per ring) on
+        wids whose actor slices were carved at construction — growth
+        never reshuffles a running worker's ε-ladder slice."""
+        stagger = (stagger_s if stagger_s is not None
+                   else self.cfg.actor.spawn_stagger_s)
+        grown: List[int] = []
+        for wid in self.grow_candidates():
+            if len(grown) >= n:
+                break
+            self._gate_shm_budget(1, include_param_buffer=False)
+            if grown and stagger:
+                time.sleep(stagger)
+            # A regrown wid sheds its retired/finished state; budget is
+            # whatever actor.T it has not yet consumed.
+            self.retired.discard(wid)
+            self.finished_workers.discard(wid)
+            self._death_pending.pop(wid, None)
+            self._dead_since.pop(wid, None)
+            budget = max(
+                0, self.cfg.actor.T - self._steps_by_worker.get(wid, 0)
+            )
+            p = self._spawn(wid, budget)
+            if wid < len(self._procs):
+                self._procs[wid] = p
+            else:
+                # grow_candidates yields ascending wids, so _procs stays
+                # index-addressable by wid (the supervise/stats contract).
+                assert wid == len(self._procs)
+                self._procs.append(p)
+            self.grows += 1
+            grown.append(wid)
+        return grown
+
+    def retire(self, wid: Optional[int] = None) -> Optional[int]:
+        """Retire one worker via CLEAN DRAIN — never SIGKILL: its
+        per-incarnation retire event ends the collect loop at the next
+        quantum boundary, the worker flushes its committed chunks and
+        exits through the normal "done" path, and the pool drains the
+        ring before reclaiming it (supervise's retired sweep).  Default
+        target is the HIGHEST live wid (scale-down walks the ladder top
+        down, so the longest-lived slices keep exploring)."""
+        live = self.live_workers()
+        if wid is None:
+            if not live:
+                return None
+            wid = live[-1]
+        if wid not in live:
+            return None
+        self.retired.add(wid)
+        self.retires += 1
+        ev = self._retire_events.get(wid)
+        if ev is not None:
+            ev.set()
+        return wid
+
+    def set_drain_budget(self, budget_bytes: int) -> int:
+        """Tune the per-poll byte drain budget live (the autopilot's
+        ring-occupancy actuator; clamped to the config floor)."""
+        self._drain_budget = max(64 << 10, int(budget_bytes))
+        return self._drain_budget
+
+    @property
+    def drain_budget_bytes(self) -> int:
+        return self._drain_budget
+
     def supervise(self) -> None:
         """Respawn dead workers (SURVEY §5 failure detection: actors are
         stateless modulo ε/seed, so recovery is respawn + param re-pull —
@@ -1075,6 +1218,14 @@ class ProcessActorPool:
             return
         now = time.monotonic()
         for wid, p in enumerate(self._procs):
+            if wid in self.retired:
+                # Clean drain in progress: never respawned.  Once the
+                # process exited, salvage reclaims the ring/queue/stats
+                # block (committed records drain into the next poll; a
+                # cleanly-retired ring has no torn tail).
+                if not p.is_alive() and wid in self._queues:
+                    self._salvage_incarnation(wid)
+                continue
             if wid in self.finished_workers or wid in self.worker_errors \
                     or wid in self.quarantined:
                 continue
@@ -1169,10 +1320,15 @@ class ProcessActorPool:
 
     @property
     def finished(self) -> bool:
-        return (
-            len(self.finished_workers) + len(self.worker_errors)
-            + len(self.quarantined)
-        ) >= self.num_workers
+        # Elastic-aware completion: every wid still expected to produce
+        # (ever spawned, not retired by the autopilot) has settled.  With
+        # no grow/retire this is exactly the legacy num_workers check.
+        if not self._spawned_local:
+            return False
+        active = set(self._spawned_local) - set(self.retired)
+        settled = (set(self.finished_workers) | set(self.worker_errors)
+                   | set(self.quarantined))
+        return all(w in settled for w in active)
 
     def poll(self, max_items: int = 64, timeout: float = 0.0,
              max_bytes: Optional[int] = None,

@@ -237,13 +237,15 @@ def _run_fleet(args, cfg, logger) -> int:
                 st = fleet.stats()
                 logger.emit(serving_router=st["router"],
                             serving_fleet={k: st[k] for k in
-                                           ("param", "respawns",
+                                           ("param", "respawns", "spawned",
+                                            "retires", "retired",
                                             "param_version", "replicas")})
     finally:
         st = fleet.stats()
         logger.emit(serving_router=st["router"],
                     serving_fleet={k: st[k] for k in
-                                   ("param", "respawns", "param_version",
+                                   ("param", "respawns", "spawned",
+                                    "retires", "retired", "param_version",
                                     "replicas")},
                     final=True)
         fleet.stop()
@@ -317,6 +319,11 @@ def main(argv=None) -> int:
         # it; give the socket source the spawn budget, not 30 s.
         source_timeout_s=(s.replica_spawn_timeout_s if args.param_hub
                           else 30.0),
+        # Chaos: seeded per-batch service delay (the serving twin of the
+        # slow-env injector — the autopilot smoke's disturbance source).
+        apply_delay_ms=(cfg.chaos.serving_delay_ms
+                        if cfg.chaos.enabled else 0.0),
+        delay_seed=cfg.chaos.seed,
     )
     server.warmup(comps.obs_shape)
     server.start()

@@ -505,6 +505,9 @@ class ServingFleet:
         if replicas < 1:
             raise ValueError("replicas must be >= 1")
         self._on_event = on_event
+        self._listen_host = listen_host
+        self._replica_args = list(replica_args or [])
+        self._env = env
         self.hub = NetTransport(host="127.0.0.1", port=0)
         self.router = ServingRouter(
             host=listen_host, port=listen_port,
@@ -525,6 +528,17 @@ class ServingFleet:
         self._stop = threading.Event()
         self._super: Optional[threading.Thread] = None
         self.respawns = 0
+        # Elastic state (spawn/retire — the autopilot's serving
+        # actuators).  _lock guards replicas-dict mutation against the
+        # supervisor thread; _spawning holds booting replicas the
+        # supervisor registers once their ports announce; a retired rid
+        # drains from rotation first, then SIGTERMs after its grace.
+        self._lock = threading.Lock()
+        self._spawning: Dict[int, ReplicaProcess] = {}
+        self.retired: set = set()
+        self._retiring: Dict[int, tuple] = {}   # rid -> (t0, grace_s)
+        self.spawned = 0
+        self.retires = 0
 
     @property
     def port(self) -> int:
@@ -587,24 +601,55 @@ class ServingFleet:
         )
 
     def _supervise(self) -> None:
-        """Pump the hub's accept loop and respawn dead replicas —
-        drain-now on death, re-enter on recovery."""
-        spawning: Dict[int, ReplicaProcess] = {}
+        """Pump the hub's accept loop, respawn dead replicas (drain-now
+        on death, re-enter on recovery), register autopilot-spawned
+        replicas once their ports announce, and walk retiring replicas
+        through drain → SIGTERM → reap."""
         while not self._stop.wait(0.05):
             self.hub.pump()
-            for rid, rep in self.replicas.items():
+            now = time.monotonic()
+            with self._lock:
+                items = list(self.replicas.items())
+            for rid, rep in items:
+                if rid in self.retired:
+                    # Retirement ladder: the endpoint already left the
+                    # router (zero NEW routes); after the grace that lets
+                    # in-flight requests finish, SIGTERM the child
+                    # (serve.py's drain handler closes its sockets), then
+                    # reap and retire the hub channel.
+                    t0, grace, signaled = self._retiring.get(
+                        rid, (now, 0.0, True)
+                    )
+                    if rep.alive():
+                        if not signaled and now - t0 >= grace:
+                            try:
+                                rep.proc.send_signal(signal.SIGTERM)
+                            except OSError:
+                                pass
+                            self._retiring[rid] = (t0, grace, True)
+                    elif rid in self._retiring:
+                        del self._retiring[rid]
+                        ch = self.hub._channels.get(rid)
+                        if ch is not None:
+                            self.hub.drop_channel(rid, ch)
+                        self._event("replica_retired_done", rid=rid)
+                    continue
                 if rep.alive():
-                    if rid in spawning and rep.port is not None \
+                    if rid in self._spawning and rep.port is not None \
                             and rep.obs_port is not None:
-                        # Respawn came up: fresh ports, back in rotation.
+                        # Boot (spawn or respawn) came up: fresh ports,
+                        # into rotation.
                         self._register(rep)
-                        del spawning[rid]
+                        del self._spawning[rid]
                         self._backoffs[rid].reset()
-                        self._event("replica_respawned", rid=rid,
-                                    port=rep.port, attempt=rep.attempt)
+                        self._event(
+                            "replica_respawned" if rep.respawns
+                            else "replica_ready",
+                            rid=rid, port=rep.port, attempt=rep.attempt,
+                        )
                     continue
                 self.router.set_healthy(rid, False, "process dead")
-                spawning.pop(rid, None)   # died mid-boot: retry via backoff
+                self._spawning.pop(rid, None)  # died mid-boot: backoff retry
                 if not self._respawn:
                     continue
                 b = self._backoffs[rid]
@@ -624,7 +669,71 @@ class ServingFleet:
                     self.hub.drop_channel(rid, old)
                 rep.spawn()
                 self.hub.make_channel(rid, rep.attempt)
-                spawning[rid] = rep
+                self._spawning[rid] = rep
+
+    # -- elastic spawn/retire (the autopilot's serving actuators) ----------
+
+    def active_replicas(self) -> List[int]:
+        """rids currently contributing capacity (booting counts — its
+        slot is claimed); retired rids are out whatever their process
+        state."""
+        with self._lock:
+            return sorted(r for r in self.replicas if r not in self.retired)
+
+    def booting(self) -> List[int]:
+        """rids spawned but not yet registered in rotation — the
+        autopilot holds further scale-ups while one is in flight."""
+        with self._lock:
+            return sorted(self._spawning)
+
+    def spawn(self) -> int:
+        """Add one replica to a RUNNING fleet (autopilot scale-up):
+        fresh rid above every rid ever used, hub channel registered
+        before the child can dial in, child spawned NON-blocking — the
+        supervisor thread registers it on the router the moment its
+        ports announce (``replica_ready``)."""
+        with self._lock:
+            rid = max(self.replicas) + 1 if self.replicas else 0
+            rep = ReplicaProcess(
+                rid, hub_host="127.0.0.1", hub_port=self.hub.port,
+                hub_token=self.hub.token, listen_host=self._listen_host,
+                extra_args=self._replica_args, env=self._env,
+            )
+            self._backoffs[rid] = Backoff(base_s=0.5, max_s=10.0, seed=rid)
+            self.hub.make_channel(rid, rep.attempt)
+            rep.spawn()
+            self.replicas[rid] = rep
+            self._spawning[rid] = rep
+            self.spawned += 1
+        self._event("replica_spawn", rid=rid)
+        return rid
+
+    def retire(self, rid: Optional[int] = None,
+               drain_grace_s: float = 2.0) -> Optional[int]:
+        """Retire one replica (autopilot scale-down) on the proven
+        zero-drop path: the endpoint leaves the router's rotation FIRST
+        (``remove_endpoint`` — zero new routes; live splices ride on),
+        then after ``drain_grace_s`` the supervisor SIGTERMs the child
+        (serve.py's drain handler closes its sockets cleanly) — clients
+        cut mid-request reconnect through the router to a live replica
+        and retry the request whole.  Default target is the highest
+        active rid.  Never SIGKILL."""
+        with self._lock:
+            candidates = [r for r in self.replicas
+                          if r not in self.retired
+                          and r not in self._spawning]
+            if rid is None:
+                rid = max(candidates) if candidates else None
+            if rid is None or rid not in candidates:
+                return None
+            self.retired.add(rid)
+            self._retiring[rid] = (time.monotonic(),
+                                   float(drain_grace_s), False)
+            self._spawning.pop(rid, None)
+            self.retires += 1
+        self.router.remove_endpoint(rid)
+        self._event("replica_retired", rid=rid)
+        return rid
 
     def stop(self) -> None:
         self._stop.set()
@@ -644,10 +753,14 @@ class ServingFleet:
     # -- observability -----------------------------------------------------
 
     def replica_varz(self) -> Dict[int, Optional[dict]]:
-        return {rid: rep.varz() for rid, rep in self.replicas.items()}
+        with self._lock:
+            items = list(self.replicas.items())
+        return {rid: rep.varz() for rid, rep in items}
 
     def stats(self) -> dict:
         hub = self.hub.stats()
+        with self._lock:
+            replica_items = list(self.replicas.items())
         return {
             "router": self.router.stats(),
             "param": {
@@ -658,6 +771,9 @@ class ServingFleet:
                           "param_last_push")
             },
             "respawns": self.respawns,
+            "spawned": self.spawned,
+            "retires": self.retires,
+            "retired": sorted(self.retired),
             "param_version": self._version,
             "replicas": {
                 str(rid): {
@@ -667,7 +783,8 @@ class ServingFleet:
                     "obs_port": rep.obs_port,
                     "attempt": rep.attempt,
                     "respawns": rep.respawns,
+                    "retired": rid in self.retired,
                 }
-                for rid, rep in self.replicas.items()
+                for rid, rep in replica_items
             },
         }

@@ -56,12 +56,24 @@ class PolicyServer:
         queue_capacity: int = 256,
         reload_poll_s: float = 0.25,
         source_timeout_s: float = 30.0,
+        apply_delay_ms: float = 0.0,
+        delay_seed: int = 0,
     ):
         import jax
 
         self._jax = jax
         self.network = network
         self._apply = build_greedy_apply(network)
+        # Chaos injector (chaos.serving_delay_ms): seeded per-batch sleep
+        # in the apply path — makes service time SLEEP-bound so replica
+        # capacity genuinely scales on a 1-core host (the serving twin of
+        # the slow-env injector; the autopilot smoke's disturbance).
+        self._apply_delay_s = float(apply_delay_ms) / 1e3
+        self._delay_rng = None
+        if self._apply_delay_s > 0:
+            import random as _random
+
+            self._delay_rng = _random.Random(0xD31A ^ int(delay_seed))
         self._source = param_source
         self._reload_poll_s = float(reload_poll_s)
         version = 0
@@ -159,6 +171,10 @@ class PolicyServer:
 
     def _run_batch(self, obs):
         params, version, _ = self._live      # one coherent snapshot per batch
+        if self._delay_rng is not None:
+            # ±25% seeded jitter so paced load doesn't phase-lock.
+            time.sleep(self._apply_delay_s
+                       * (0.75 + 0.5 * self._delay_rng.random()))
         actions, q = self._jax.device_get(self._apply(params, obs))
         return actions, q, version
 

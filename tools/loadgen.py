@@ -564,6 +564,213 @@ def run_socket_compare(replica_counts=(1, 2), **kw) -> dict:
     return out
 
 
+def parse_schedule(spec: str):
+    """``"0:20,10:80,25:10"`` → [(t_offset_s, target_qps), ...] — a step
+    schedule: the target holds from its offset until the next entry."""
+    steps = []
+    for item in spec.split(","):
+        t, qps = item.split(":", 1)
+        steps.append((float(t), float(qps)))
+    steps.sort()
+    if not steps or steps[0][0] > 0:
+        steps.insert(0, (0.0, steps[0][1] if steps else 0.0))
+    return steps
+
+
+def _schedule_target(steps, elapsed: float) -> float:
+    qps = steps[0][1]
+    for t, q in steps:
+        if elapsed >= t:
+            qps = q
+        else:
+            break
+    return qps
+
+
+def run_schedule_loadgen(
+    host: str,
+    port: int,
+    schedule,
+    *,
+    clients: int = 8,
+    duration: float = 30.0,
+    obs_shape=(84, 84, 1),
+    seed: int = 0,
+    tick_s: float = 1.0,
+    act_timeout: float = 30.0,
+    jsonl_path: str = None,
+    stop_evt=None,
+    conn_ttl_s: float = 0.0,
+) -> dict:
+    """Time-varying load (``--schedule``): PACED clients drive a step
+    schedule of target QPS over real sockets — the disturbance source
+    the elastic autopilot is tested against (ROADMAP item 3).
+
+    Pacing: each of ``clients`` threads owes one request every
+    ``clients / target_qps`` seconds against its own due-clock; when the
+    service can't keep up the due-clock forgives debt beyond one
+    interval (bounded burstiness — offered load tracks the schedule,
+    it does not snowball).  A per-``tick_s`` collector computes the
+    achieved QPS and windowed latency percentiles, tagged with the
+    schedule phase — the ``series``; per-phase aggregates land in
+    ``phases``; with ``jsonl_path`` each tick is also appended as one
+    JSONL record (``event=loadgen_tick``).  A request counts DROPPED
+    only when its deadline expires unanswered — reconnect/retry churn is
+    the transport's job and is counted, not failed.
+
+    ``conn_ttl_s`` > 0 makes each client recycle its connection on that
+    cadence: the router balances at CONNECTION granularity, so churn is
+    what lets a freshly scaled-up replica take its share of an
+    already-connected fleet (production load balancers rely on the same
+    property)."""
+    import numpy as np
+
+    from ape_x_dqn_tpu.serving import ServerOverloaded, ServingClient
+
+    steps = (parse_schedule(schedule) if isinstance(schedule, str)
+             else sorted(schedule))
+    stop = stop_evt or threading.Event()
+    lock = threading.Lock()
+    samples: list = []          # (t_done_rel, lat_ms, kind)
+    counts = {"requests": 0, "shed": 0, "timeouts": 0, "errors": 0,
+              "retries": 0, "reconnects": 0}
+    t0 = time.monotonic()
+
+    def client(i: int) -> None:
+        crng = np.random.default_rng(seed + 1000 + i)
+        c = ServingClient(host, port, seed=seed + i)
+        conn_born = time.monotonic()
+        due = t0 + (i / max(1, clients)) * 1.0   # spread the first wave
+        while not stop.is_set():
+            now = time.monotonic()
+            if conn_ttl_s > 0 and now - conn_born > conn_ttl_s:
+                with lock:
+                    counts["retries"] += c.retries
+                    counts["reconnects"] += c.reconnects
+                c.close()
+                c = ServingClient(host, port, seed=seed + i)
+                conn_born = now
+            if now < due:
+                if stop.wait(min(due - now, 0.25)):
+                    break
+                continue
+            el = now - t0
+            target = _schedule_target(steps, el)
+            interval = clients / max(target, 1e-3)
+            obs = crng.integers(0, 255, obs_shape, dtype=np.uint8)
+            kind = "ok"
+            lat_ms = None
+            try:
+                r = c.act(obs, timeout=act_timeout)
+                lat_ms = r.latency_s * 1e3
+            except ServerOverloaded:
+                kind = "shed"
+            except TimeoutError:
+                kind = "timeout"
+            except Exception:  # noqa: BLE001 — counted, loop continues
+                kind = "error"
+            done = time.monotonic()
+            with lock:
+                if kind == "ok":
+                    counts["requests"] += 1
+                    samples.append((done - t0, lat_ms, kind))
+                else:
+                    counts[{"shed": "shed", "timeout": "timeouts",
+                            "error": "errors"}[kind]] += 1
+            # Bounded debt: fall at most one interval behind schedule.
+            due = max(due + interval, done - interval)
+        with lock:
+            counts["retries"] += c.retries
+            counts["reconnects"] += c.reconnects
+        c.close()
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+
+    series: list = []
+    jsonl = open(jsonl_path, "a") if jsonl_path else None
+    tick_start = 0.0
+    consumed = 0
+    try:
+        while not stop.is_set():
+            el = time.monotonic() - t0
+            if el >= duration:
+                stop.set()
+                break
+            stop.wait(min(tick_s, duration - el))
+            now_rel = time.monotonic() - t0
+            with lock:
+                window = samples[consumed:]
+                consumed = len(samples)
+                snap = dict(counts)
+            lat = [s[1] for s in window]
+            phase = sum(1 for t_, _ in steps if t_ <= tick_start) - 1
+            rec = {
+                "t": round(tick_start, 2),
+                "phase": phase,
+                "target_qps": _schedule_target(steps, tick_start),
+                "qps": round(len(window) / max(now_rel - tick_start,
+                                               1e-6), 2),
+                "p50_ms": _pct(lat, 50),
+                "p99_ms": _pct(lat, 99),
+                "requests": snap["requests"],
+                "shed": snap["shed"],
+                "timeouts": snap["timeouts"],
+                "errors": snap["errors"],
+            }
+            series.append(rec)
+            if jsonl is not None:
+                jsonl.write(json.dumps(
+                    {"event": "loadgen_tick", **rec}) + "\n")
+                jsonl.flush()
+            tick_start = now_rel
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=act_timeout + 10.0)
+        if jsonl is not None:
+            jsonl.close()
+
+    phases: list = []
+    for pi, (pt, pq) in enumerate(steps):
+        ticks = [r for r in series if r["phase"] == pi]
+        if not ticks:
+            continue
+        with lock:
+            p_lat = [s[1] for s in samples
+                     if pt <= s[0] < (steps[pi + 1][0]
+                                      if pi + 1 < len(steps)
+                                      else float("inf"))]
+        phases.append({
+            "phase": pi,
+            "t0": pt,
+            "target_qps": pq,
+            "ticks": len(ticks),
+            "qps_mean": round(sum(r["qps"] for r in ticks)
+                              / len(ticks), 2),
+            "p50_ms": _pct(p_lat, 50),
+            "p95_ms": _pct(p_lat, 95),
+            "p99_ms": _pct(p_lat, 99),
+            "max_ms": round(max(p_lat), 3) if p_lat else None,
+        })
+    with lock:
+        final = dict(counts)
+    return {
+        "config": {"connect": f"{host}:{port}", "clients": clients,
+                   "duration_s": duration, "tick_s": tick_s,
+                   "obs_shape": list(obs_shape)},
+        "schedule": [[t, q] for t, q in steps],
+        "series": series,
+        "phases": phases,
+        **final,
+        "checks": {
+            "zero_drops": bool(final["timeouts"] + final["errors"] == 0),
+        },
+    }
+
+
 def run_connect_loadgen(host: str, port: int, clients: int,
                         duration: float, obs_shape, think_ms: float,
                         seed: int) -> dict:
@@ -640,6 +847,21 @@ def main(argv=None) -> int:
                    help="replica env spec (fixes obs shape + num_actions)")
     p.add_argument("--warm-s", type=float, default=1.5,
                    help="socket-mode warmup seconds outside the clock")
+    p.add_argument(
+        "--schedule", default=None, metavar="T:QPS,T:QPS,...",
+        help="time-varying load: a step schedule of target QPS over the "
+        "run (paced clients; per-phase/per-tick series on the output) — "
+        "pairs with --connect or --serve-replicas; --duration still "
+        "bounds the whole run",
+    )
+    p.add_argument("--schedule-jsonl", default=None, metavar="PATH",
+                   help="append one loadgen_tick JSONL record per tick")
+    p.add_argument("--tick-s", type=float, default=1.0,
+                   help="schedule-mode collector tick")
+    p.add_argument("--conn-ttl-s", type=float, default=0.0,
+                   help="schedule-mode connection recycle cadence (0 = "
+                   "persistent connections; churn lets a scaled-up "
+                   "replica take load from connected clients)")
     args = p.parse_args(argv)
 
     if args.platform:
@@ -663,7 +885,47 @@ def main(argv=None) -> int:
         seed=args.seed,
         warm_s=args.warm_s,
     )
-    if args.compare_replicas:
+    if args.schedule and args.connect:
+        host, port = args.connect.rsplit(":", 1)
+        result = run_schedule_loadgen(
+            host or "127.0.0.1", int(port), args.schedule,
+            clients=args.clients, duration=args.duration,
+            obs_shape=_parse_obs(args.obs), seed=args.seed,
+            tick_s=args.tick_s, jsonl_path=args.schedule_jsonl,
+            conn_ttl_s=args.conn_ttl_s,
+        )
+    elif args.schedule and args.serve_replicas:
+        # Spawn the routed fleet, then drive the schedule through it.
+        import jax
+        import numpy as np
+
+        from ape_x_dqn_tpu.config import ApexConfig, apply_overrides
+        from ape_x_dqn_tpu.runtime.components import build_components
+        from ape_x_dqn_tpu.serving import ServingFleet
+
+        cfg = apply_overrides(ApexConfig(), [
+            f"network={args.network}", f"env.name={args.env}",
+        ])
+        comps = build_components(cfg)
+        fleet = ServingFleet(
+            replicas=args.serve_replicas,
+            replica_args=["--set", f"network={args.network}",
+                          "--set", f"env.name={args.env}"],
+        )
+        fleet.publish(jax.tree_util.tree_map(
+            np.array, jax.device_get(comps.state.params)))
+        try:
+            fleet.start()
+            result = run_schedule_loadgen(
+                "127.0.0.1", fleet.port, args.schedule,
+                clients=args.clients, duration=args.duration,
+                obs_shape=comps.obs_shape, seed=args.seed,
+                tick_s=args.tick_s, jsonl_path=args.schedule_jsonl,
+                conn_ttl_s=args.conn_ttl_s,
+            )
+        finally:
+            fleet.stop()
+    elif args.compare_replicas:
         counts = tuple(int(x) for x in args.compare_replicas.split(","))
         result = run_socket_compare(
             counts, kill_replica_at=args.kill_replica_at, **socket_kw

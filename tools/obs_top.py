@@ -168,6 +168,27 @@ def render_fleet(snap: dict) -> str:
                 f"({r.get('samples', 0)} samples)  "
                 f"b/c {r.get('breaches', 0)}/{r.get('clears', 0)}"
             )
+    ap = fleet.get("autopilot") or snap.get("autopilot")
+    if ap:
+        fleets = ap.get("fleets") or {}
+        lines.append(
+            f"-- autopilot {'DRY-RUN ' if ap.get('dry_run') else ''}"
+            f"({ap.get('actions', 0)} actions, "
+            f"{ap.get('decisions', 0)} decisions) " + "-" * 24
+        )
+        for name in sorted(fleets):
+            f = fleets[name]
+            breaching = f.get("breaching") or []
+            lines.append(
+                f" {name:<10} size {f.get('size', '?')}"
+                f" [{f.get('min', '?')}..{f.get('max', '?')}]"
+                f"{' BOOTING' if f.get('busy') else '':<9}"
+                f"last {f.get('last_action') or '-'}"
+                f"({f.get('last_rule') or '-'})  "
+                f"cd up/down {_num(f.get('cooldown_up_s'), '{:.0f}')}/"
+                f"{_num(f.get('cooldown_down_s'), '{:.0f}')}s  "
+                f"{'BREACH[' + ','.join(breaching) + ']' if breaching else 'green'}"
+            )
     traces = fleet.get("traces") or []
     if traces:
         lines.append(f"-- traces ({len(traces)} recent timelines) " + "-" * 24)

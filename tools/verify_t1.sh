@@ -79,14 +79,26 @@
 #         fire a damped slo_breach, the shard must respawn, and
 #         slo_clear must follow — with the rollup serving throughout
 #         (tools/fleet_obs_smoke.py).
-# Gate 13: apexlint — the repo's static invariant checkers
+# Gate 13: elastic-autopilot smoke — ROADMAP item 3's done-condition,
+#         CI-sized: an in-process trainer (process actors under slow-env
+#         chaos, autopilot enabled) next to a 1-replica serving fleet
+#         with sleep-bound service time, driven by a loadgen QPS step
+#         schedule.  The controller must decide NOTHING while every SLO
+#         is green; under the surge it must spawn replica 2 (one step,
+#         busy-held) and the windowed serving p99 must re-hold; in the
+#         idle phase it must retire the replica on the zero-drop drain
+#         path (zero loadgen timeouts/errors across the run); and after
+#         kill-half-the-workers quarantines a wid, it must grow the
+#         reserved wid on the same ε-ladder partition until the windowed
+#         age-of-experience p95 re-holds (tools/autopilot_smoke.py).
+# Gate 14: apexlint — the repo's static invariant checkers
 #         (ape_x_dqn_tpu/analysis/ + tools/lint.py; docs/INVARIANTS.md):
 #         import-lightness of the no-jax child modules, the wire
 #         kind/magic registry, config coverage, metrics-doc coverage,
 #         shm discipline, typed-error discipline.  Purely static (~2 s;
 #         hard budget 20 s), fails on any finding NEW relative to the
 #         committed baseline.
-# Gate 14: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
+# Gate 15: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
 #         command changes, change it HERE too (they must stay
 #         character-identical modulo this wrapper's cd).
 cd "$(dirname "$0")/.." || exit 1
@@ -102,5 +114,6 @@ timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/serving_net_smoke.py > /tmp
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/replay_svc_smoke.py > /tmp/_t1_rsvc.log 2>&1 || { echo "replay-svc smoke FAILED:"; cat /tmp/_t1_rsvc.log; exit 1; }
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/central_inference_smoke.py > /tmp/_t1_central.log 2>&1 || { echo "central-inference smoke FAILED:"; cat /tmp/_t1_central.log; exit 1; }
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/fleet_obs_smoke.py > /tmp/_t1_fleet.log 2>&1 || { echo "fleet-obs smoke FAILED:"; cat /tmp/_t1_fleet.log; exit 1; }
+timeout -k 10 500 env JAX_PLATFORMS=cpu python tools/autopilot_smoke.py > /tmp/_t1_autopilot.log 2>&1 || { echo "autopilot smoke FAILED:"; cat /tmp/_t1_autopilot.log; exit 1; }
 timeout -k 5 20 python -m tools.lint --fail-on-new > /tmp/_t1_lint.log 2>&1 || { echo "apexlint gate FAILED:"; cat /tmp/_t1_lint.log; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
