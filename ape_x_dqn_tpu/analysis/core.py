@@ -51,6 +51,7 @@ IMPORT_LIGHT_CONTRACT: Tuple[str, ...] = (
     "ape_x_dqn_tpu.runtime.shm_ring",
     "ape_x_dqn_tpu.obs.shm_stats",
     "ape_x_dqn_tpu.obs.fleet",
+    "ape_x_dqn_tpu.fleet",
     "ape_x_dqn_tpu.analysis",
     "tools.xp_transport",
     "tools.host_join",

@@ -14,7 +14,12 @@ rollup and driving CAPACITY, not just recovery:
     age-of-experience p95 under its bound and ring occupancy in band;
   * **serving fleet** — grow/retire replicas through
     ``ServingFleet.spawn()`` and the router's proven zero-drop
-    drain-from-rotation (``retire``), against the QPS-floor / p99 SLOs.
+    drain-from-rotation (``retire``), against the QPS-floor / p99 SLOs;
+  * **replay fleet** — grow/retire replay shards through
+    ``ReplayServiceFleet.grow()``/``retire()`` (live slot-range
+    resharding with a digest-proven handoff), against the per-shard
+    add-QPS pressure signal (``obs.fleet_slo_replay_add_qps_high`` up,
+    ``autopilot.replay_idle_add_qps_per_shard`` down).
 
 Every decision passes the shared guardrails (min/max bounds,
 per-direction cooldowns, a hold window against the opposite direction —
@@ -37,6 +42,7 @@ _LAZY = {
     "Guardrails": "ape_x_dqn_tpu.autopilot.controller",
     "ActorPoolActuator": "ape_x_dqn_tpu.autopilot.actuators",
     "ServingFleetActuator": "ape_x_dqn_tpu.autopilot.actuators",
+    "ReplayFleetActuator": "ape_x_dqn_tpu.autopilot.actuators",
 }
 
 __all__ = sorted(_LAZY)

@@ -1,12 +1,13 @@
-"""Concrete actuators binding the controller to the two fleets.
+"""Concrete actuators binding the controller to the three fleets.
 
 Thin, state-light adapters: every capacity primitive they call is owned
 by the fleet object itself (``ProcessActorPool.grow``/``retire``/
 ``set_drain_budget``, ``ServingFleet.spawn``/``retire``,
-``DispatchPipeline.degrade``) — the actuator only names the protocol the
-controller speaks (``size``/``busy``/``scale_up``/``scale_down`` + the
-actor loop's tuning ladder), so unit tests drive the controller with
-dict-recording fakes and never spawn a process.
+``ReplayServiceFleet.grow``/``retire``, ``DispatchPipeline.degrade``) —
+the actuator only names the protocol the controller speaks
+(``size``/``busy``/``scale_up``/``scale_down`` + the actor loop's
+tuning ladder), so unit tests drive the controller with dict-recording
+fakes and never spawn a process.
 """
 
 from __future__ import annotations
@@ -112,3 +113,50 @@ class ServingFleetActuator:
         rid = self._fleet.retire(drain_grace_s=self._grace)
         self._notify("retire", rid)
         return {"rid": rid} if rid is not None else None
+
+
+class ReplayFleetActuator:
+    """Replay-fleet actuator over a ``ReplayServiceFleet`` — the third
+    autopilot-governed fleet.
+
+    Scale-up is ``fleet.grow()`` (spawn + announce a fresh highest-sid
+    shard); scale-down is ``fleet.retire()`` (drain → stop → restore →
+    digest-proven re-ingest into the survivors).  Both return None when
+    nothing moved (spawn failed, handoff digest mismatch, nothing
+    retirable) — the controller books that as ``exhausted``, never a
+    crash.  ``on_scale(kind, sid)`` mirrors the serving actuator's
+    observer hook so a driver can keep its aggregator in step when it is
+    not membership-driven.
+    """
+
+    def __init__(self, fleet, *, drain_grace_s: float = 0.5,
+                 on_scale: Optional[Callable] = None):
+        self._fleet = fleet
+        self._grace = float(drain_grace_s)
+        self._on_scale = on_scale
+
+    def size(self) -> int:
+        return int(self._fleet.num_shards)
+
+    def busy(self) -> bool:
+        # One topology change at a time: a reshard in flight (grow's
+        # spawn-and-announce or a retire's handoff chain) holds further
+        # actuation until the slot-range math is settled.
+        return bool(self._fleet.resharding())
+
+    def _notify(self, kind: str, sid) -> None:
+        if self._on_scale is not None and sid is not None:
+            try:
+                self._on_scale(kind, sid)
+            except Exception:  # noqa: BLE001 — observer must not block actuation
+                pass
+
+    def scale_up(self) -> Optional[dict]:
+        sid = self._fleet.grow()
+        self._notify("grow", sid)
+        return {"sid": sid} if sid is not None else None
+
+    def scale_down(self) -> Optional[dict]:
+        sid = self._fleet.retire(drain_grace_s=self._grace)
+        self._notify("retire", sid)
+        return {"sid": sid} if sid is not None else None

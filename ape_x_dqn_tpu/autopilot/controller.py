@@ -1,4 +1,4 @@
-"""The autopilot's decision core: guardrails + the two-fleet controller.
+"""The autopilot's decision core: guardrails + the multi-fleet controller.
 
 Control law, per fleet, per tick:
 
@@ -9,7 +9,10 @@ Control law, per fleet, per tick:
     fleet's idle rule — evaluated on the controller's OWN burn-window
     engine, so scale-down inherits the same damping — says the capacity
     is sitting unused (serving: per-replica QPS under
-    ``autopilot.serving_idle_qps_per_replica``);
+    ``autopilot.serving_idle_qps_per_replica``; replay: per-shard add
+    QPS under ``autopilot.replay_idle_add_qps_per_shard`` — add RATE,
+    not occupancy, because a full ring stays full after a grow and an
+    occupancy pair would oscillate);
   * the actor loop's ring-occupancy-high response is a LADDER: tune the
     pool's drain budget up (×2 per action, bounded by
     ``autopilot.drain_tune_max_factor``) before any worker is retired —
@@ -49,6 +52,16 @@ DEFAULT_RULE_FLEETS: Dict[str, tuple] = {
     "serving_p99_ms": ("serving", "up"),
     "serving_qps": ("serving", "up"),
     "inference_rtt_p99_ms": ("serving", "up"),
+    "replay_add_qps": ("replay", "up"),
+}
+
+# Idle (scale-down) rules the controller's OWN burn-window engine owns,
+# mapped to the fleet they shrink.  Kept separate from the breach-driven
+# map: an idle rule only ever gates scale-down while everything else on
+# its fleet is green.
+IDLE_RULE_FLEETS: Dict[str, str] = {
+    "serving_idle": "serving",
+    "replay_idle": "replay",
 }
 
 _RECENT = 8
@@ -156,6 +169,12 @@ class AutopilotController:
                 cfg.serving_idle_qps_per_replica,
                 self._serving_qps_per_replica,
             ))
+        if getattr(cfg, "replay_idle_add_qps_per_shard", 0.0) > 0:
+            idle_rules.append(SloRule(
+                "replay_idle", "lower",
+                cfg.replay_idle_add_qps_per_shard,
+                self._replay_add_qps_per_shard,
+            ))
         self._idle = SloEngine(
             idle_rules, window_s=cfg.idle_window_s,
             burn_threshold=0.6, clear_threshold=0.3, min_samples=3,
@@ -199,6 +218,17 @@ class AutopilotController:
         )
         return self
 
+    def attach_replay(self, actuator) -> "AutopilotController":
+        """Replay-fleet actuator (ReplayFleetActuator shape:
+        size/busy/scale_up/scale_down over ReplayServiceFleet's
+        grow/retire reshard primitives)."""
+        self._make_fleet(
+            "replay", actuator,
+            min_size=self.cfg.replay_min_shards,
+            max_size=self.cfg.replay_max_shards,
+        )
+        return self
+
     def on_slo_event(self, name: str, **fields) -> None:
         """SLO-engine subscription hook (``SloEngine.subscribe``):
         breach/clear transitions queue here and apply on the next
@@ -232,6 +262,18 @@ class AutopilotController:
             return None
         return float(qps) / max(1, fleet.actuator.size())
 
+    def _replay_add_qps_per_shard(self, rollup: dict) -> Optional[float]:
+        rep = (rollup or {}).get("replay") or {}
+        fleet = self._fleets.get("replay")
+        if fleet is None or fleet.actuator is None:
+            return None
+        if not rep.get("shards_alive"):
+            return None
+        qps = rep.get("add_qps")
+        if qps is None:
+            return None
+        return float(qps) / max(1, fleet.actuator.size())
+
     # -- the decision sweep ------------------------------------------------
 
     def _drain_events(self) -> None:
@@ -242,8 +284,8 @@ class AutopilotController:
             if rule is None:
                 continue
             owner = None
-            if rule == "serving_idle":
-                owner = self._fleets.get("serving")
+            if rule in IDLE_RULE_FLEETS:
+                owner = self._fleets.get(IDLE_RULE_FLEETS[rule])
             else:
                 fleet_name, _dir = self._rule_fleets.get(rule, (None, None))
                 owner = self._fleets.get(fleet_name)
@@ -282,7 +324,9 @@ class AutopilotController:
             return None
         ups = fleet.up_breaches(self._rule_fleets)
         downs = fleet.down_breaches(self._rule_fleets)
-        idle = "serving_idle" in fleet.breaching
+        idle_rule = next(
+            (r for r, owner in IDLE_RULE_FLEETS.items()
+             if owner == fleet.name and r in fleet.breaching), None)
         if ups:
             rule = ups[0]
             reason = fleet.guard.check("up", act.size(), now,
@@ -320,13 +364,13 @@ class AutopilotController:
                 return None
             return self._fire(fleet, "down", "scale_down", rule,
                               act.scale_down, now)
-        if idle and not ups:
+        if idle_rule is not None and not ups:
             reason = fleet.guard.check("down", act.size(), now,
                                        busy=act.busy())
             if reason is not None:
                 self._suppress(fleet, "down", reason)
                 return None
-            return self._fire(fleet, "down", "scale_down", "serving_idle",
+            return self._fire(fleet, "down", "scale_down", idle_rule,
                               act.scale_down, now)
         return None
 

@@ -389,6 +389,12 @@ class ReplayConfig:
     # Fleet width for the service-side launcher (replay/service.py CLI /
     # tools; the client takes its shard map from the endpoints file).
     service_shards: int = 2
+    # Tiered frame store INSIDE each shard: > 0 caps the frame bytes a
+    # ReplayShardServer's PrioritizedReplay holds hot (replay/tiered.py
+    # spills least-recently-sampled spans under <ckpt_dir>/spill) — the
+    # service-side twin of replay.hot_frame_budget_bytes, which stays a
+    # learner-LOCAL feature.  0 disables: shards host dense rings.
+    service_hot_frame_budget_bytes: int = 0
 
 
 @dataclasses.dataclass
@@ -505,6 +511,11 @@ class ObsConfig:
     # or below low (actors starved).  Defaults (0, 1] leave both off.
     fleet_slo_ring_occupancy_low: float = 0.0
     fleet_slo_ring_occupancy_high: float = 1.0
+    # Replay add-path backpressure ceiling: breach while the replay
+    # fleet's per-shard add QPS (scrape-to-scrape total_added deltas
+    # over live shards) exceeds this — the signal the autopilot's
+    # replay loop grows shard count on.  0 = rule off.
+    fleet_slo_replay_add_qps_high: float = 0.0
     # Endpoint-liveness rule (on by default): breach while any
     # registered endpoint is failing its scrapes.
     fleet_slo_endpoint_alive: bool = True
@@ -519,6 +530,44 @@ class ObsConfig:
     # Minimum window samples before ANY transition (one bad scrape is
     # not a breach; one good one is not a recovery).
     fleet_slo_min_samples: int = 3
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Fleet discovery plane (ape_x_dqn_tpu/fleet/registry.py).
+
+    The run-token-scoped membership registry every tier can join over
+    the announce wire (``F_FANN``/``F_FREP``): replay shards, serving
+    replicas and remote worker hosts register themselves instead of the
+    driver plumbing ports through files and pipes.  ``discovery``
+    selects which seam the replay client/aggregator trust; the endpoints
+    file stays available as the compat fallback.
+    """
+
+    # "registry": membership (the announce channel) drives replay-client
+    # and aggregator routing; the endpoints file is only a bootstrap/
+    # fallback.  "endpoints": the pre-discovery behavior, unchanged.
+    discovery: str = "endpoints"
+    # Where the trainer hosts the registry.  Port 0 = ephemeral (the
+    # bound port is what fleets/tools hand their members).
+    registry_host: str = "127.0.0.1"
+    registry_port: int = 0
+    # Member announce cadence; the registry's lease sweep expires a
+    # member not heard from within ttl_s (member_lost, reason ttl).
+    heartbeat_s: float = 1.0
+    ttl_s: float = 5.0
+
+    def validate_section(self) -> list:
+        return [
+            (self.discovery in ("registry", "endpoints"),
+             f"unknown fleet.discovery: {self.discovery}"),
+            (0 <= self.registry_port <= 65535,
+             "fleet.registry_port must be in [0, 65535]"),
+            (self.heartbeat_s > 0.0, "fleet.heartbeat_s must be > 0"),
+            (self.ttl_s >= self.heartbeat_s,
+             "fleet.ttl_s must be >= fleet.heartbeat_s (a member must "
+             "get at least one beat per lease)"),
+        ]
 
 
 @dataclasses.dataclass
@@ -629,6 +678,19 @@ class AutopilotConfig:
     # to this multiple of its configured value BEFORE any worker is
     # retired — drain harder first, shrink the fleet last.
     drain_tune_max_factor: float = 4.0
+    # --- replay fleet (the third autopilot loop; needs fleet.discovery
+    # --- =registry so membership, not the endpoints file, carries the
+    # --- resharded shard map to clients) ---
+    # Shard-count bounds the controller may move the replay fleet
+    # between (ReplayServiceFleet.grow / retire — retire is a digest-
+    # proven slot-range handoff into the survivors, never a data drop).
+    replay_min_shards: int = 1
+    replay_max_shards: int = 4
+    # Idle scale-down rule for the replay loop: shards step down (toward
+    # the floor) only while the fleet's per-shard add QPS has sat under
+    # this bound for the idle burn window AND every governing SLO is
+    # green.  0 disables — the replay fleet then only ever scales up.
+    replay_idle_add_qps_per_shard: float = 0.0
 
     def validate_section(self) -> list:
         return [
@@ -652,6 +714,13 @@ class AutopilotConfig:
              "autopilot.idle_window_s must be > 0"),
             (self.drain_tune_max_factor >= 1.0,
              "autopilot.drain_tune_max_factor must be >= 1"),
+            (self.replay_min_shards >= 1,
+             "autopilot.replay_min_shards must be >= 1"),
+            (self.replay_max_shards >= self.replay_min_shards,
+             "autopilot.replay_max_shards must be >= "
+             "autopilot.replay_min_shards"),
+            (self.replay_idle_add_qps_per_shard >= 0.0,
+             "autopilot.replay_idle_add_qps_per_shard must be >= 0"),
         ]
 
 
@@ -749,6 +818,7 @@ class ApexConfig:
     replay: ReplayConfig = dataclasses.field(default_factory=ReplayConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     obs: ObsConfig = dataclasses.field(default_factory=ObsConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     supervisor: SupervisorConfig = dataclasses.field(
         default_factory=SupervisorConfig
     )
@@ -878,6 +948,7 @@ class ApexConfig:
              "serving.replica_spawn_timeout_s must be > 0"),
             (s.param_tail_base_every >= 1,
              "serving.param_tail_base_every must be >= 1"),
+            *self.fleet.validate_section(),
             *self.supervisor.validate_section(),
             *self.autopilot.validate_section(),
             *self.chaos.validate_section(),
@@ -948,6 +1019,10 @@ class ApexConfig:
             (r.service_probe_interval_s > 0.0,
              "replay.service_probe_interval_s must be > 0"),
             (r.service_shards >= 1, "replay.service_shards must be >= 1"),
+            (r.service_hot_frame_budget_bytes >= 0,
+             "replay.service_hot_frame_budget_bytes must be >= 0"),
+            (o.fleet_slo_replay_add_qps_high >= 0.0,
+             "obs.fleet_slo_replay_add_qps_high must be >= 0"),
             (r.service_mode == "off"
              or not (r.dedup or r.frame_compression
                      or r.hot_frame_budget_bytes or l.device_replay),

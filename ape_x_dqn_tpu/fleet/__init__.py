@@ -1,0 +1,15 @@
+"""Fleet discovery plane — run-token-scoped membership for every tier.
+
+``fleet/registry.py`` hosts the registry (trainer side) and the
+announcer/client (member side); both speak the ``F_FANN``/``F_FREP``
+kinds registered in ``runtime/net.py``.  Import-light by contract: the
+registry runs inside shard/replica/tool processes that must never pay a
+jax import.
+"""
+
+from ape_x_dqn_tpu.fleet.registry import (  # noqa: F401
+    FleetAnnouncer,
+    FleetClient,
+    FleetRegistry,
+    member_doc,
+)

@@ -50,6 +50,7 @@ import os
 import threading
 import time
 import urllib.request
+import zlib
 from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -66,7 +67,7 @@ from ape_x_dqn_tpu.utils.metrics import (
 _SHARD_SUM_KEYS = (
     "requests", "replies", "errors", "torn_frames", "bad_hellos",
     "stale_rejects", "add_dups", "chaos_dropped", "bytes_in", "bytes_out",
-    "logical_bytes_in", "size", "total_added", "saves",
+    "logical_bytes_in", "size", "capacity", "total_added", "saves",
 )
 _MAX_TRACES = 256      # trace ids kept for timeline assembly (LRU)
 _ROLLUP_TRACES = 8     # newest multi-process timelines on the rollup
@@ -312,6 +313,17 @@ def _ring_occupancy(rollup: dict) -> Optional[float]:
     return rollup.get("ring_occupancy_max")
 
 
+def _replay_add_qps_per_shard(rollup: dict) -> Optional[float]:
+    """Fleet replay ingest pressure NORMALIZED per live shard — the
+    signal that stays comparable across reshards: growing the fleet
+    lowers it, shrinking raises it, so one bound governs both ends."""
+    rep = rollup.get("replay") or {}
+    shards = int(rep.get("shards_alive") or 0)
+    if shards <= 0:
+        return None
+    return float(rep.get("add_qps") or 0.0) / shards
+
+
 def _endpoints_down(rollup: dict) -> Optional[float]:
     eps = rollup.get("endpoints") or {}
     if not eps:
@@ -347,6 +359,10 @@ def rules_from_config(obs_cfg) -> List[SloRule]:
         rules.append(SloRule("ring_occupancy_floor", "lower",
                              obs_cfg.fleet_slo_ring_occupancy_low,
                              _ring_occupancy))
+    if obs_cfg.fleet_slo_replay_add_qps_high > 0:
+        rules.append(SloRule("replay_add_qps", "upper",
+                             obs_cfg.fleet_slo_replay_add_qps_high,
+                             _replay_add_qps_per_shard))
     if obs_cfg.fleet_slo_endpoint_alive:
         rules.append(SloRule("endpoints_alive", "upper", 0.0,
                              _endpoints_down))
@@ -459,7 +475,11 @@ class FleetAggregator:
             slo._emit = self._emit
         self._lock = threading.Lock()
         self._eps: "OrderedDict[str, _Endpoint]" = OrderedDict()
-        self._replay_files: List[dict] = []   # {path, mtime, token, codec}
+        self._replay_files: List[dict] = []   # {path, digest}
+        self._registry_fn: Optional[Callable[[], dict]] = None
+        self._member_adopted: set = set()
+        self._membership: dict = {}
+        self.membership_adopts = 0
         self._traces: "OrderedDict[int, dict]" = OrderedDict()
         self._rollup: dict = {"endpoints": {}}
         self.scrapes = 0
@@ -508,20 +528,23 @@ class FleetAggregator:
     def watch_replay_endpoints(self, path: str) -> None:
         """Discover replay shards from the fleet's endpoints file (the
         atomic tmp+rename publication clients already re-resolve); the
-        file is re-read on mtime change each sweep, so a respawned
-        shard's fresh port/incarnation is adopted automatically."""
-        self._replay_files.append({"path": path, "mtime": -1.0})
+        file's CONTENT digest gates the re-read each sweep — mtime has
+        filesystem-granularity resolution, so a rewrite within the same
+        tick (respawn storms do this) would be invisible to an
+        mtime-equality early-out."""
+        self._replay_files.append({"path": path, "digest": None})
         self._refresh_replay_files()
 
     def _refresh_replay_files(self) -> None:
         for src in self._replay_files:
             try:
-                mtime = os.path.getmtime(src["path"])
-                if mtime == src["mtime"]:
+                with open(src["path"], "rb") as f:
+                    raw = f.read()
+                digest = zlib.crc32(raw)
+                if digest == src["digest"]:
                     continue
-                with open(src["path"]) as f:
-                    doc = json.load(f)
-                src["mtime"] = mtime
+                doc = json.loads(raw.decode("utf-8"))
+                src["digest"] = digest
             except (OSError, ValueError):
                 continue
             token = int(doc.get("token", 0))
@@ -539,6 +562,79 @@ class FleetAggregator:
                                                     shard_spec=spec)
                     else:
                         ep.shard_spec = spec
+
+    # -- membership adoption (fleet discovery plane) -----------------------
+
+    def bind_registry(self, registry) -> None:
+        """Adopt fleet membership from an in-process
+        :class:`~ape_x_dqn_tpu.fleet.registry.FleetRegistry`: every sweep
+        re-reads ``registry.snapshot()`` and reconciles the endpoint set
+        against it — replay shards become stats-RPC scrape specs keyed by
+        their announced slot base, serving replicas and worker hosts join
+        by their announced ``varz_url``.  Under ``fleet.discovery =
+        "registry"`` this REPLACES the endpoints-file watch and the
+        driver-handed replica ports: the membership registry is the one
+        source of scrape-target truth."""
+        self._registry_fn = registry.snapshot
+        self.adopt_membership(registry.snapshot())
+
+    def adopt_membership(self, snapshot: dict) -> None:
+        """Reconcile the endpoint set against one membership snapshot
+        (also the ``on_membership`` hook shape a FleetAnnouncer pushes).
+        Members that left (reshard, retire, TTL expiry) drop their
+        endpoints ON PURPOSE — a departed member must not read as a
+        liveness breach."""
+        snapshot = snapshot or {}
+        members = snapshot.get("members") or {}
+        token = int(snapshot.get("token", 0))
+        version = int(snapshot.get("version", 0))
+        adopted: set = set()
+        draining: List[str] = []
+        by_kind: Dict[str, int] = {}
+        for name, doc in members.items():
+            kind = str(doc.get("kind", ""))
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+            if doc.get("draining"):
+                draining.append(name)
+            if kind == "replay_shard":
+                cap = int(doc.get("capacity", 0))
+                port = int(doc.get("port", 0))
+                if cap <= 0 or port <= 0:
+                    continue
+                sid = int(doc.get("base", 0)) // cap
+                ep_name = f"replay_shard{sid}"
+                spec = {
+                    "id": sid, "host": doc.get("host") or "127.0.0.1",
+                    "port": port, "token": token,
+                    "incarnation": int(doc.get("incarnation", -1)),
+                }
+                with self._lock:
+                    ep = self._eps.get(ep_name)
+                    if ep is None:
+                        self._eps[ep_name] = _Endpoint(ep_name, "shard",
+                                                       shard_spec=spec)
+                    else:
+                        ep.shard_spec = spec
+                adopted.add(ep_name)
+            elif doc.get("varz_url"):
+                ep_kind = {"serving_replica": "replica",
+                           "worker_host": "host"}.get(kind, "trainer")
+                self.add_varz(name, str(doc["varz_url"]), kind=ep_kind)
+                adopted.add(name)
+        for stale in self._member_adopted - adopted:
+            self.remove_endpoint(stale)
+        self._member_adopted = adopted
+        if version != self._membership.get("version"):
+            self.membership_adopts += 1
+        self._membership = {
+            "version": version,
+            "incarnation": int(snapshot.get("incarnation", 0)),
+            "members": len(members),
+            "by_kind": by_kind,
+            "draining": sorted(draining),
+            "adopted_endpoints": len(adopted),
+            "adopts": self.membership_adopts,
+        }
 
     # -- scraping ----------------------------------------------------------
 
@@ -567,6 +663,11 @@ class FleetAggregator:
         evaluate the SLO rules.  Returns the rollup (also kept for the
         /varz provider).  A failing endpoint is marked down and the sweep
         continues — the fleet view never dies of a member's death."""
+        if self._registry_fn is not None:
+            try:
+                self.adopt_membership(self._registry_fn())
+            except Exception:  # noqa: BLE001 — membership adoption must never kill the sweep
+                pass
         self._refresh_replay_files()
         now = time.monotonic() if now is None else float(now)
         with self._lock:
@@ -602,8 +703,8 @@ class FleetAggregator:
             try:
                 compact = {k: rollup.get(k) for k in (
                     "alive", "expected", "age_of_experience", "inference",
-                    "serving", "replay", "ring_occupancy_max",
-                    "scrape_failures",
+                    "serving", "replay", "membership",
+                    "ring_occupancy_max", "scrape_failures",
                 )}
                 rec = stamp_record({"fleet": compact,
                                     "slo": self.slo.status()})
@@ -677,6 +778,7 @@ class FleetAggregator:
         shard_ms_buckets: dict = {}
         shard_counters: dict = {}
         shards_alive = 0
+        replay_add_qps = 0.0
         inference_p99: List[float] = []
         inference_stall = 0.0
         inference_replies = 0
@@ -699,6 +801,16 @@ class FleetAggregator:
                         shard_counters,
                         {k: snap[k] for k in _SHARD_SUM_KEYS if k in snap},
                     )
+                    # Per-shard ingest rate from prev-mark deltas of the
+                    # monotone total_added counter (the serving qps
+                    # pattern) — THE autopilot grow signal: occupancy
+                    # saturates once a ring wraps, add rate does not.
+                    added = float(snap.get("total_added", 0))
+                    mark = ep.prev_qps_mark
+                    if mark is not None and now > mark[0]:
+                        replay_add_qps += max(0.0, added - mark[1]) \
+                            / (now - mark[0])
+                    ep.prev_qps_mark = (now, added)
                 continue
             # HTTP/local endpoints: lineage / inference / serving /
             # workers / autopilot.
@@ -812,12 +924,19 @@ class FleetAggregator:
             },
             "replay": {
                 "shards_alive": shards_alive,
+                "add_qps": round(replay_add_qps, 2),
+                "occupancy": (
+                    round(float(shard_counters.get("size", 0))
+                          / float(shard_counters["capacity"]), 4)
+                    if shard_counters.get("capacity") else None),
                 "op_p95_ms": round(
                     bucket_percentile(shard_ms_buckets, 95) * 1e3, 3)
                 if shard_ms_buckets else None,
                 "op_buckets": shard_ms_buckets,
                 **shard_counters,
             },
+            "membership": dict(self._membership) if self._membership
+            else None,
             "ring_occupancy_max": (round(max(ring_occ), 4)
                                    if ring_occ else None),
             "autopilot": autopilot,

@@ -79,6 +79,7 @@ import json
 import os
 import secrets
 import select
+import shutil
 import signal
 import socket
 import struct
@@ -86,9 +87,17 @@ import subprocess
 import sys
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ape_x_dqn_tpu.fleet.registry import (
+    FleetAnnouncer,
+    FleetClient,
+    member_doc,
+    member_id_for,
+)
 
 from ape_x_dqn_tpu.runtime.net import (
     CODEC_OFF,
@@ -335,6 +344,10 @@ class ReplayShardServer:
         # Shard-owned persistence: the incremental chain under
         # <ckpt_dir>; save() runs on the pump thread at the wall cadence
         # (step = transitions ever added — the shard's own clock).
+        # Tiered (spill-backed) hosting: spans/bytes spilled cold by the
+        # pump thread's watermark sweep (zeros on an untiered store).
+        self.spill_spans = 0
+        self.spill_bytes = 0
         self._ckpt = None
         self._save_every_s = float(save_every_s)
         self._next_save = time.monotonic() + self._save_every_s
@@ -434,6 +447,24 @@ class ReplayShardServer:
                 if conn is not None:
                     self._on_readable(conn)
             self._maybe_save()
+            self._maybe_spill()
+
+    def _maybe_spill(self) -> None:
+        """Spill-backed shard (replay.service_hot_frame_budget_bytes):
+        evict cold spans on the pump thread when the tiered store runs
+        over its high watermark — serialized with every mutation by
+        construction, so the spill never races an add.  A no-op on the
+        untiered store."""
+        over = getattr(self.replay, "tier_over_watermark", None)
+        if over is None or not over():
+            return
+        try:
+            spans, nbytes = self.replay.spill_cold()
+            self.spill_spans += int(spans)
+            self.spill_bytes += int(nbytes)
+        except Exception as e:  # noqa: BLE001 — a sick spill path is an event, sampling stays correct
+            self._event("shard_spill_error",
+                        error=f"{type(e).__name__}: {e}")
 
     def _maybe_save(self) -> None:
         if self._ckpt is None or self._save_every_s <= 0:
@@ -806,8 +837,11 @@ class ReplayShardServer:
             "reply_raw": self.reply_raw,
             "auto_codec_on": self._auto_on,
             "size": int(self.replay.size()),
+            "capacity": int(self.replay.capacity),
             "total_added": int(self.replay.total_added),
             "saves": self.saves,
+            "spill_spans": self.spill_spans,
+            "spill_bytes": self.spill_bytes,
             # Fleet-rollup surfaces (obs/fleet.py): the service-latency
             # histogram ships summary + raw buckets so the aggregator can
             # merge shards bucket-wise; recent cross-tier spans ride the
@@ -1065,6 +1099,31 @@ class ShardClient:
         self._drop()
 
 
+def _membership_shards(snapshot: dict) -> List[dict]:
+    """Endpoint-file-shaped shard dicts from a fleet-registry snapshot:
+    the ``replay_shard`` members with live ports, sid recovered from the
+    slot-range base (``base // capacity`` — the fleet keeps shards
+    uniform and contiguous, so the mapping is exact)."""
+    out = []
+    for m in snapshot.get("members", {}).values():
+        if m.get("kind") != "replay_shard":
+            continue
+        port = int(m.get("port", 0))
+        cap = int(m.get("capacity", 0))
+        if port <= 0 or cap <= 0:
+            continue
+        out.append({
+            "id": int(m.get("base", 0)) // cap,
+            "host": str(m.get("host", "127.0.0.1")),
+            "port": port,
+            "base": int(m.get("base", 0)),
+            "capacity": cap,
+            "incarnation": int(m.get("incarnation", -1)),
+            "draining": bool(m.get("draining", False)),
+        })
+    return sorted(out, key=lambda s: s["id"])
+
+
 class ShardedReplayClient:
     """The learner-facing replay: a PrioritizedReplay-shaped facade
     (``add`` / ``sample`` / ``update_priorities`` / ``size``) over the
@@ -1088,6 +1147,14 @@ class ShardedReplayClient:
       * Only when EVERY shard is unreachable does an op raise the typed
         :class:`ReplayShardUnavailable`; ``age_s`` (the ``replay_svc``
         health component) reports how long the fleet has been degraded.
+
+    The routing set is ELASTIC: shard clients live in sid-keyed maps, so
+    :meth:`adopt_membership` (fed by the fleet registry's snapshots —
+    :meth:`from_registry`) can admit a grown shard, stop routing adds at
+    a draining one, and retire a removed one without rebuilding the
+    facade.  Priority write-backs routed at a since-retired slot range
+    are counted (``updates_dropped``), never raised — the transitions
+    themselves were handed off server-side.
     """
 
     remote = True
@@ -1114,17 +1181,22 @@ class ShardedReplayClient:
             if int(s["id"]) != k or int(s["base"]) != k * self.shard_capacity:
                 raise ValueError("shard ids/bases must tile [0, capacity)")
         self._dedup = bool(dedup)
+        self._token = int(token)
+        self._codec_name = codec
         self._codec_id = _CODEC_IDS[codec]
         self._timeout = float(request_timeout_s)
         self._probe_interval = float(probe_interval_s)
         self._endpoints_path = endpoints_path
-        self._endpoints_mtime = 0.0
+        self._endpoints_digest: Optional[int] = None
+        self._seed = int(seed)
         self._on_event = on_event
         if client_id is None:
             client_id = (os.getpid() << 16) ^ secrets.randbits(16)
         self.client_id = int(client_id)
-        self._clients: List[ShardClient] = []
-        self._locks: List[threading.Lock] = []
+        # Elastic routing set: sid-keyed, mutated only under _state by
+        # adopt_membership; readers take point-in-time copies.
+        self._clients: Dict[int, ShardClient] = {}
+        self._locks: Dict[int, threading.Lock] = {}
         # Cross-tier tracing (negotiated per connection): the learner's
         # RPC hops join the experience lineage — client-side spans land
         # here, the shard-side halves ride each shard's stats RPC.
@@ -1132,20 +1204,20 @@ class ShardedReplayClient:
         self.spans = TraceSpanLog(depth=128)
         self._last_sample: Optional[Tuple[int, float, float]] = None
         for s in shards:
-            self._clients.append(ShardClient(
-                int(s["id"]), s["host"], int(s["port"]), token=int(token),
-                client_id=self.client_id,
-                incarnation=int(s.get("incarnation", -1)), codec=codec,
-                trace=self.trace,
-                io_timeout_s=min(5.0, request_timeout_s),
-                seed=seed ^ self.client_id,
-            ))
-            self._locks.append(threading.Lock())
+            sid = int(s["id"])
+            self._clients[sid] = self._make_shard_client(
+                sid, s["host"], int(s["port"]),
+                int(s.get("incarnation", -1)),
+            )
+            self._locks[sid] = threading.Lock()
         self._state = threading.Lock()
         self._down: Dict[int, float] = {}        # sid -> down_since
+        self._draining: set = set()              # sids leaving the add path
         self._pending: Dict[int, Dict[int, float]] = {}  # sid -> idx->prio
-        self._totals = [0.0] * self.num_shards   # cached p^α mass per shard
-        self._sizes = [0] * self.num_shards
+        self._totals: Dict[int, float] = {       # cached p^α mass per shard
+            sid: 0.0 for sid in self._clients
+        }
+        self._sizes: Dict[int, int] = {sid: 0 for sid in self._clients}
         self._size_t = 0.0
         self._add_rr = 0
         self._degraded_since: Optional[float] = None
@@ -1159,10 +1231,28 @@ class ShardedReplayClient:
         self.shard_unavailable = 0     # per-shard deadline expiries seen
         self.writeback_buffered = 0    # slots ever parked for a down shard
         self.writeback_flushed = 0     # slots flushed on recovery
+        self.updates_dropped = 0       # slots routed at a retired shard
         self.probes = 0
         self.recoveries = 0
+        self.membership_adopts = 0
+        self.membership_version = -1
+        # rpc_* accumulators of since-retired shard clients, so the
+        # stats sums stay monotone across membership churn.
+        self._retired_rpc = {"retries": 0, "reconnects": 0, "torn": 0,
+                             "hello_rejects": 0}
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
+        self._watcher: Optional[FleetAnnouncer] = None
+
+    def _make_shard_client(self, sid: int, host: str, port: int,
+                           incarnation: int) -> ShardClient:
+        return ShardClient(
+            sid, host, int(port), token=self._token,
+            client_id=self.client_id, incarnation=int(incarnation),
+            codec=self._codec_name, trace=self.trace,
+            io_timeout_s=min(5.0, self._timeout),
+            seed=self._seed ^ self.client_id,
+        )
 
     @classmethod
     def from_endpoints_file(cls, path: str, **kwargs) -> "ShardedReplayClient":
@@ -1172,11 +1262,129 @@ class ShardedReplayClient:
         return cls(doc["shards"], token=int(doc["token"]),
                    endpoints_path=path, **kwargs)
 
+    @classmethod
+    def from_registry(cls, host: str, port: int, *, token: int,
+                      wait_timeout_s: float = 30.0,
+                      **kwargs) -> "ShardedReplayClient":
+        """Build a client whose routing set is DRIVEN by the fleet
+        registry (``fleet.discovery=registry`` — no endpoints file):
+        blocks until at least one ``replay_shard`` member is announced,
+        then keeps adopting membership snapshots over a watcher
+        heartbeat, so grow/drain/retire propagate without any file
+        polling."""
+        probe = FleetClient(
+            host, int(port), token=int(token),
+            member_id=member_id_for(f"replay-client-{os.getpid()}"),
+        )
+        deadline = time.monotonic() + float(wait_timeout_s)
+        shards: List[dict] = []
+        try:
+            while time.monotonic() < deadline:
+                try:
+                    snap = probe.sync()
+                except ConnectionError:
+                    time.sleep(0.05)
+                    continue
+                shards = _membership_shards(snap)
+                if shards:
+                    break
+                time.sleep(0.05)
+        finally:
+            probe.close()
+        if not shards:
+            raise ReplayShardUnavailable(
+                f"no replay_shard member announced within "
+                f"{wait_timeout_s:.1f}s", op="discover",
+            )
+        client = cls(shards, token=int(token), **kwargs)
+        client._watch_registry(host, int(port))
+        return client
+
+    def _watch_registry(self, host: str, port: int) -> None:
+        self._watcher = FleetAnnouncer(
+            host, int(port), token=self._token,
+            member_id=member_id_for(f"replay-client-{self.client_id}"),
+            heartbeat_s=self._probe_interval,
+            on_membership=self.adopt_membership,
+            seed=self._seed ^ self.client_id,
+        )
+        self._watcher.start()
+
+    # -- membership (the fleet registry's routing feed) --------------------
+
+    def adopt_membership(self, snapshot: dict) -> None:
+        """Adopt one registry snapshot as the routing set: new
+        ``replay_shard`` members get clients, moved ones re-resolve,
+        draining ones leave the add path, removed ones retire (their
+        parked write-backs are DROPPED and counted — the slot range no
+        longer exists).  An empty shard list never wipes the routing set
+        (a registry cold start must not strand the learner)."""
+        shards = _membership_shards(snapshot)
+        specs = {int(s["id"]): s for s in shards
+                 if int(s["capacity"]) == self.shard_capacity}
+        if not specs:
+            return
+        removed: List[ShardClient] = []
+        moved: List[Tuple[ShardClient, dict]] = []
+        with self._state:
+            current = set(self._clients)
+            want = set(specs)
+            for sid in sorted(want - current):
+                m = specs[sid]
+                self._clients[sid] = self._make_shard_client(
+                    sid, m["host"], int(m["port"]),
+                    int(m.get("incarnation", -1)),
+                )
+                self._locks[sid] = threading.Lock()
+                self._totals.setdefault(sid, 0.0)
+                self._sizes.setdefault(sid, 0)
+            for sid in sorted(current - want):
+                removed.append(self._clients.pop(sid))
+                self._locks.pop(sid, None)
+                self._totals.pop(sid, None)
+                self._sizes.pop(sid, None)
+                self._down.pop(sid, None)
+                dropped = self._pending.pop(sid, None)
+                if dropped:
+                    self.updates_dropped += len(dropped)
+            for sid in sorted(want & current):
+                moved.append((self._clients[sid], specs[sid]))
+            self._draining = {sid for sid, m in specs.items()
+                              if m.get("draining")}
+            self.num_shards = len(self._clients)
+            self.capacity = self.shard_capacity * self.num_shards
+            if not self._down:
+                self._degraded_since = None
+            for c in removed:
+                self._retired_rpc["retries"] += c.retries
+                self._retired_rpc["reconnects"] += c.reconnects
+                self._retired_rpc["torn"] += c.torn
+                self._retired_rpc["hello_rejects"] += c.hello_rejects
+            self.membership_version = int(snapshot.get("version", -1))
+            self.membership_adopts += 1
+        for cli, m in moved:
+            cli.set_endpoint(m["host"], int(m["port"]),
+                             int(m.get("incarnation", -1)))
+        for c in removed:
+            c.close()
+        if removed or (want - current):
+            self._event("replay_routing_changed",
+                        shards=sorted(specs),
+                        version=self.membership_version)
+
     # -- health ------------------------------------------------------------
 
     def _healthy(self) -> List[int]:
         with self._state:
-            return [k for k in range(self.num_shards) if k not in self._down]
+            return [k for k in sorted(self._clients) if k not in self._down]
+
+    def _addable(self) -> List[int]:
+        """Shards eligible for NEW experience: healthy and not draining
+        (a draining shard still answers sample/update — its range is
+        mid-handoff — but must stop accumulating)."""
+        with self._state:
+            return [k for k in sorted(self._clients)
+                    if k not in self._down and k not in self._draining]
 
     @property
     def degraded(self) -> bool:
@@ -1233,18 +1441,24 @@ class ShardedReplayClient:
         if not path:
             return
         try:
-            mtime = os.path.getmtime(path)
-            if mtime == self._endpoints_mtime:
+            # Change detection by CONTENT digest, never mtime equality:
+            # two atomic rewrites can land inside one filesystem
+            # timestamp granule, and an mtime early-out would skip the
+            # second forever — the respawned shard's new port unseen,
+            # the probe loop stuck dialing the old incarnation.
+            with open(path, "rb") as f:
+                raw = f.read()
+            digest = zlib.crc32(raw)
+            if digest == self._endpoints_digest:
                 return
-            with open(path) as f:
-                doc = json.load(f)
-            self._endpoints_mtime = mtime
+            doc = json.loads(raw.decode("utf-8"))
+            self._endpoints_digest = digest
         except (OSError, ValueError):
             return
         for s in doc.get("shards", []):
-            sid = int(s["id"])
-            if 0 <= sid < self.num_shards:
-                self._clients[sid].set_endpoint(
+            cli = self._clients.get(int(s["id"]))
+            if cli is not None:
+                cli.set_endpoint(
                     s["host"], int(s["port"]), int(s.get("incarnation", -1))
                 )
 
@@ -1256,10 +1470,13 @@ class ShardedReplayClient:
                 continue
             self._refresh_endpoints()
             for sid in down:
+                lock, cli = self._locks.get(sid), self._clients.get(sid)
+                if lock is None or cli is None:
+                    continue          # retired while parked on the down list
                 self.probes += 1
                 try:
-                    with self._locks[sid]:
-                        self._clients[sid].digest(
+                    with lock:
+                        cli.digest(
                             with_crc=False,
                             timeout=max(0.25, self._probe_interval),
                         )
@@ -1278,10 +1495,16 @@ class ShardedReplayClient:
             pending = self._pending.pop(sid, None)
         if not pending:
             return
+        cli = self._clients.get(sid)
+        if cli is None:
+            # Retired mid-park: the slot range was handed off — the
+            # priorities have nowhere valid to land.
+            self.updates_dropped += len(pending)
+            return
         idx = np.fromiter(pending.keys(), np.int64, len(pending))
         prio = np.fromiter(pending.values(), np.float64, len(pending))
         try:
-            self._clients[sid].request(
+            cli.request(
                 OP_UPDATE,
                 encode_body({"idx": idx, "prio": prio},
                             codec=self._codec_id, dedup=False),
@@ -1320,16 +1543,20 @@ class ShardedReplayClient:
         }
         trace_id = trace_id if self.trace else 0
         body = encode_body(arrays, codec=self._codec_id, dedup=self._dedup)
-        candidates = self._healthy() or list(range(self.num_shards))
+        candidates = (self._addable() or self._healthy()
+                      or sorted(self._clients))
         self._add_rr += 1
         order = candidates[self._add_rr % len(candidates):] \
             + candidates[:self._add_rr % len(candidates)]
         last_err: Optional[ReplayShardUnavailable] = None
         for pos, sid in enumerate(order):
+            lock, cli = self._locks.get(sid), self._clients.get(sid)
+            if lock is None or cli is None:
+                continue              # retired between choice and dispatch
             try:
                 t0 = time.monotonic()
-                with self._locks[sid]:
-                    _flags, rep = self._clients[sid].request(
+                with lock:
+                    _flags, rep = cli.request(
                         OP_ADD, body, timeout=self._timeout,
                         trace_id=trace_id,
                     )
@@ -1339,9 +1566,10 @@ class ShardedReplayClient:
                 if pos:
                     self.add_rerouted += 1
                 with self._state:
-                    self._sizes[sid] = min(
-                        self._sizes[sid] + len(idx), self.shard_capacity
-                    )
+                    if sid in self._sizes:
+                        self._sizes[sid] = min(
+                            self._sizes[sid] + len(idx), self.shard_capacity
+                        )
                 return np.asarray(idx, np.int64) \
                     + sid * self.shard_capacity
             except ReplayShardUnavailable as e:
@@ -1360,9 +1588,10 @@ class ShardedReplayClient:
         rng = rng or np.random.default_rng()
         candidates = self._healthy()
         if not candidates:
-            candidates = list(range(self.num_shards))
+            candidates = sorted(self._clients)
         with self._state:
-            totals = {k: max(0.0, self._totals[k]) for k in candidates}
+            totals = {k: max(0.0, self._totals.get(k, 0.0))
+                      for k in candidates}
         # Mass-weighted shard order: positive-mass shards first (drawn
         # without replacement ∝ their cached p^α totals — shard choice ×
         # in-shard proportional = the global law), zero/unknown-mass
@@ -1379,10 +1608,13 @@ class ShardedReplayClient:
         last_err: Optional[BaseException] = None
         for pos, sid in enumerate(map(int, order)):
             seed = int(rng.integers(0, 2 ** 63 - 1))
+            lock, cli = self._locks.get(sid), self._clients.get(sid)
+            if lock is None or cli is None:
+                continue              # retired between choice and dispatch
             try:
                 t0 = time.monotonic()
-                with self._locks[sid]:
-                    _flags, rep = self._clients[sid].request(
+                with lock:
+                    _flags, rep = cli.request(
                         OP_SAMPLE,
                         _SAMPLE_REQ.pack(int(batch_size), float(beta), seed),
                         timeout=self._timeout,
@@ -1405,10 +1637,11 @@ class ShardedReplayClient:
             total, size = _SAMPLE_REP.unpack_from(rep, 0)
             arrays = decode_body(rep[_SAMPLE_REP.size:])
             with self._state:
-                self._totals[sid] = float(total)
-                self._sizes[sid] = int(size)
-                g_total = sum(self._totals)
-                g_size = sum(self._sizes)
+                if sid in self._clients:
+                    self._totals[sid] = float(total)
+                    self._sizes[sid] = int(size)
+                g_total = sum(self._totals.values())
+                g_size = sum(self._sizes.values())
             self.samples += 1
             mass = np.asarray(arrays["mass"], np.float64)
             probs = mass / max(g_total, 1e-12)
@@ -1459,6 +1692,13 @@ class ShardedReplayClient:
             m = sids == sid
             idx = indices[m] - sid * self.shard_capacity
             prio = priorities[m]
+            lock, cli = self._locks.get(sid), self._clients.get(sid)
+            if lock is None or cli is None:
+                # The slot range was retired (resharded away): the
+                # transitions live on under NEW global indices on the
+                # survivors — this stale write-back has no target.
+                self.updates_dropped += int(idx.size)
+                continue
             with self._state:
                 down = sid in self._down
             if down:
@@ -1466,8 +1706,8 @@ class ShardedReplayClient:
                 continue
             try:
                 t0 = time.monotonic()
-                with self._locks[sid]:
-                    self._clients[sid].request(
+                with lock:
+                    cli.request(
                         OP_UPDATE,
                         encode_body({"idx": idx, "prio": prio},
                                     codec=self._codec_id, dedup=False),
@@ -1503,18 +1743,22 @@ class ShardedReplayClient:
                 self._size_t = now
         if stale:
             for sid in self._healthy():
+                lock, cli = self._locks.get(sid), self._clients.get(sid)
+                if lock is None or cli is None:
+                    continue
                 try:
-                    with self._locks[sid]:
-                        d = self._clients[sid].digest(
+                    with lock:
+                        d = cli.digest(
                             with_crc=False, timeout=min(2.0, self._timeout)
                         )
                     with self._state:
-                        self._sizes[sid] = int(d["size"])
-                        self._totals[sid] = float(d["total_mass"])
+                        if sid in self._clients:
+                            self._sizes[sid] = int(d["size"])
+                            self._totals[sid] = float(d["total_mass"])
                 except (ReplayShardUnavailable, ReplayRpcError) as e:
                     self._mark_down(sid, f"digest: {e}")
         with self._state:
-            return int(sum(self._sizes))
+            return int(sum(self._sizes.values()))
 
     @property
     def total_added(self) -> int:
@@ -1534,13 +1778,17 @@ class ShardedReplayClient:
         tests/test_replay_svc.py)."""
         with self._state:
             down = sorted(self._down)
+            draining = sorted(self._draining)
             pending = sum(len(d) for d in self._pending.values())
-            sizes = list(self._sizes)
-            totals = list(self._totals)
+            sizes = list(self._sizes.values())
+            totals = list(self._totals.values())
+            clients = list(self._clients.values())
+            retired = dict(self._retired_rpc)
         return {
             "shards": self.num_shards,
             "shards_down": len(down),
             "down": down,
+            "shards_draining": draining,
             "degraded": bool(down),
             "degraded_age_s": round(self.age_s(), 3),
             "size": int(sum(sizes)),
@@ -1554,19 +1802,30 @@ class ShardedReplayClient:
             "writeback_buffered": self.writeback_buffered,
             "writeback_flushed": self.writeback_flushed,
             "writeback_pending": pending,
+            "updates_dropped": self.updates_dropped,
             "probes": self.probes,
             "recoveries": self.recoveries,
-            "rpc_retries": sum(c.retries for c in self._clients),
-            "rpc_reconnects": sum(c.reconnects for c in self._clients),
-            "rpc_torn": sum(c.torn for c in self._clients),
-            "hello_rejects": sum(c.hello_rejects for c in self._clients),
+            "membership_version": self.membership_version,
+            "membership_adopts": self.membership_adopts,
+            "rpc_retries": retired["retries"]
+            + sum(c.retries for c in clients),
+            "rpc_reconnects": retired["reconnects"]
+            + sum(c.reconnects for c in clients),
+            "rpc_torn": retired["torn"] + sum(c.torn for c in clients),
+            "hello_rejects": retired["hello_rejects"]
+            + sum(c.hello_rejects for c in clients),
         }
 
     def close(self) -> None:
         self._stop.set()
+        if self._watcher is not None:
+            self._watcher.close(leave=False)
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=5.0)
-        for lock, c in zip(self._locks, self._clients):
+        with self._state:
+            pairs = [(self._locks[sid], self._clients[sid])
+                     for sid in sorted(self._clients)]
+        for lock, c in pairs:
             with lock:
                 c.close()
 
@@ -1586,12 +1845,14 @@ class ReplayShardProcess:
                  token: int, root_dir: str, priority_exponent: float = 0.6,
                  codec: str = "zlib", save_every_s: float = 2.0,
                  base_every: int = 16, host: str = "127.0.0.1",
+                 hot_frame_budget_bytes: int = 0,
                  rpc_delay_ms: float = 0.0, rpc_drop_rate: float = 0.0,
                  chaos_seed: int = 0):
         self.shard_id = int(shard_id)
         self.capacity = int(capacity)
         self.obs_shape = tuple(int(d) for d in obs_shape)
         self.token = int(token)
+        self.hot_frame_budget_bytes = int(hot_frame_budget_bytes)
         # Absolute by contract: the shard subprocess runs with the REPO
         # as its cwd (for the -m import), so a relative dir would land
         # its chain inside the source tree.
@@ -1635,6 +1896,9 @@ class ReplayShardProcess:
             "--save-every-s", str(self.save_every_s),
             "--base-every", str(self.base_every),
         ]
+        if self.hot_frame_budget_bytes > 0:
+            args += ["--hot-frame-budget-bytes",
+                     str(self.hot_frame_budget_bytes)]
         if self.rpc_delay_ms or self.rpc_drop_rate:
             args += ["--rpc-delay-ms", str(self.rpc_delay_ms),
                      "--rpc-drop-rate", str(self.rpc_drop_rate),
@@ -1711,12 +1975,29 @@ class ReplayServiceFleet:
     """Owner of the shard fleet: spawn, supervise (RespawnPolicy backoff
     + crash-loop quarantine), endpoints publication, and the chaos
     kill-shard hooks.  ``auto_respawn=False`` hands respawn timing to the
-    caller (the smoke's deterministic mid-kill chain inspection)."""
+    caller (the smoke's deterministic mid-kill chain inspection).
+
+    The fleet is ELASTIC: :meth:`grow` appends a fresh empty shard at
+    the next slot range, :meth:`retire` removes the HIGHEST shard after
+    a digest-proven handoff — drain, final committed chain, bit-exact
+    restore proof, re-add into the survivors — so only uniform
+    contiguous geometries ever exist and the client's ``index //
+    shard_capacity`` routing stays exact through every resize.  Both are
+    the :class:`~ape_x_dqn_tpu.autopilot.actuators.ReplayFleetActuator`
+    surface.  With ``registry_addr`` set, every shard is announced to
+    the fleet registry (kind ``replay_shard``) and membership — not the
+    endpoints file — drives client/aggregator routing; the file is still
+    written as the compat fallback.
+    """
 
     def __init__(self, num_shards: int, capacity: int, obs_shape, *,
                  root_dir: str, priority_exponent: float = 0.6,
                  codec: str = "zlib", save_every_s: float = 2.0,
                  base_every: int = 16, endpoints_path: Optional[str] = None,
+                 token: Optional[int] = None,
+                 hot_frame_budget_bytes: int = 0,
+                 registry_addr: Optional[Tuple[str, int]] = None,
+                 heartbeat_s: float = 1.0,
                  auto_respawn: bool = True, respawn_base_s: float = 0.25,
                  respawn_max_s: float = 5.0, crash_loop_budget: int = 6,
                  rpc_delay_ms: float = 0.0, rpc_drop_rate: float = 0.0,
@@ -1731,10 +2012,21 @@ class ReplayServiceFleet:
             )
         from ape_x_dqn_tpu.runtime.supervisor import RespawnPolicy
 
-        self.token = secrets.randbits(63) or 1
+        # With a registry the fleet authenticates shards under the RUN
+        # token (the registry's), so one credential covers discovery and
+        # the replay RPC hello; standalone keeps the private random one.
+        self.token = int(token) if token else (secrets.randbits(63) or 1)
         self.num_shards = int(num_shards)
         self.capacity = int(capacity)
         self.shard_capacity = self.capacity // self.num_shards
+        self.obs_shape = tuple(int(d) for d in obs_shape)
+        self.alpha = float(priority_exponent)
+        self.save_every_s = float(save_every_s)
+        self.base_every = int(base_every)
+        self.hot_frame_budget_bytes = int(hot_frame_budget_bytes)
+        self.rpc_delay_ms = float(rpc_delay_ms)
+        self.rpc_drop_rate = float(rpc_drop_rate)
+        self.chaos_seed = int(chaos_seed)
         self.root_dir = os.path.abspath(root_dir)
         root_dir = self.root_dir
         os.makedirs(root_dir, exist_ok=True)
@@ -1753,26 +2045,39 @@ class ReplayServiceFleet:
         import random as _random
 
         self._chaos_rng = _random.Random(chaos_seed ^ 0x5A4D)
-        self.shards = [
-            ReplayShardProcess(
-                k, self.shard_capacity, obs_shape, token=self.token,
-                root_dir=root_dir, priority_exponent=priority_exponent,
-                codec=codec, save_every_s=save_every_s,
-                base_every=base_every, rpc_delay_ms=rpc_delay_ms,
-                rpc_drop_rate=rpc_drop_rate, chaos_seed=chaos_seed + k,
-            )
-            for k in range(self.num_shards)
-        ]
+        self.shards = [self._make_shard(k) for k in range(self.num_shards)]
         self.respawns = 0
         self.kills = 0
+        self.grows = 0
+        self.retires = 0
         self.quarantined: set = set()
+        self._registry_addr = registry_addr
+        self._heartbeat_s = float(heartbeat_s)
+        self._announcer: Optional[FleetAnnouncer] = None
+        self._reshard_lock = threading.Lock()
+        self._resharding = False
+        self._retiring: Optional[int] = None   # supervisor must not respawn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def _event(self, kind: str, **fields) -> None:
+    def _make_shard(self, sid: int) -> ReplayShardProcess:
+        return ReplayShardProcess(
+            sid, self.shard_capacity, self.obs_shape, token=self.token,
+            root_dir=self.root_dir, priority_exponent=self.alpha,
+            codec=self.codec, save_every_s=self.save_every_s,
+            base_every=self.base_every,
+            hot_frame_budget_bytes=self.hot_frame_budget_bytes,
+            rpc_delay_ms=self.rpc_delay_ms,
+            rpc_drop_rate=self.rpc_drop_rate,
+            chaos_seed=self.chaos_seed + sid,
+        )
+
+    def _event(self, name: str, **fields) -> None:
+        # Positional param deliberately NOT named ``kind``: the reshard
+        # events carry a ``kind="grow"/"retire"`` field of their own.
         if self._on_event is not None:
             try:
-                self._on_event(kind, **fields)
+                self._on_event(name, **fields)
             except Exception:  # noqa: BLE001 — observer callback must never break the fleet/client
                 pass
 
@@ -1805,6 +2110,22 @@ class ReplayServiceFleet:
 
     # -- lifecycle ---------------------------------------------------------
 
+    def _shard_doc(self, s: ReplayShardProcess,
+                   draining: bool = False) -> dict:
+        return member_doc(
+            f"replay/shard{s.shard_id}", "replay_shard",
+            host=s.host, port=s.port or 0,
+            incarnation=s.incarnation,
+            base=s.shard_id * self.shard_capacity,
+            capacity=s.capacity, draining=draining,
+        )
+
+    def _announce_shard(self, s: ReplayShardProcess,
+                        draining: bool = False) -> None:
+        if self._announcer is not None:
+            self._announcer.set_member(self._shard_doc(s, draining))
+            self._announcer.poke()
+
     def start(self, timeout: float = 60.0) -> "ReplayServiceFleet":
         deadline = time.monotonic() + timeout
         for s in self.shards:
@@ -1817,6 +2138,16 @@ class ReplayServiceFleet:
                     f"{s.incarnation}.log)"
                 )
         self.write_endpoints()
+        if self._registry_addr is not None:
+            host, port = self._registry_addr
+            self._announcer = FleetAnnouncer(
+                host, int(port), token=self.token,
+                member_id=member_id_for(f"replay-fleet-{os.getpid()}"),
+                heartbeat_s=self._heartbeat_s, on_event=self._on_event,
+            )
+            for s in self.shards:
+                self._announcer.set_member(self._shard_doc(s))
+            self._announcer.start()
         if self._auto_respawn:
             self._thread = threading.Thread(
                 target=self._supervise_loop, name="replay-fleet", daemon=True
@@ -1826,7 +2157,7 @@ class ReplayServiceFleet:
 
     def respawn(self, shard_id: int, timeout: float = 60.0) -> None:
         """Respawn one shard now (fresh incarnation; recovers from its
-        checkpoint chain) and republish endpoints."""
+        checkpoint chain) and republish endpoints + membership."""
         s = self.shards[shard_id]
         s.spawn()
         if not s.wait_announce(timeout):
@@ -1835,8 +2166,232 @@ class ReplayServiceFleet:
             )
         self.respawns += 1
         self.write_endpoints()
+        self._announce_shard(s)
         self._event("replay_shard_respawned", shard=shard_id,
                     incarnation=s.incarnation, port=s.port)
+
+    # -- elastic resharding (the autopilot's replay actuator surface) ------
+
+    def resharding(self) -> bool:
+        with self._reshard_lock:
+            return self._resharding
+
+    def _begin_reshard(self) -> bool:
+        with self._reshard_lock:
+            if self._resharding:
+                return False
+            self._resharding = True
+            return True
+
+    def _end_reshard(self) -> None:
+        with self._reshard_lock:
+            self._resharding = False
+
+    def grow(self, timeout: float = 60.0) -> Optional[int]:
+        """Split: append one fresh EMPTY shard at the next slot range
+        (sid = current count — geometries stay uniform and contiguous,
+        so client routing math survives).  Returns the new sid, or None
+        when a reshard is already in flight or the spawn failed."""
+        if not self._begin_reshard():
+            return None
+        sid = self.num_shards
+        try:
+            self._event("reshard_started", kind="grow", shard=sid,
+                        shards_from=self.num_shards,
+                        shards_to=self.num_shards + 1)
+            s = self._make_shard(sid)
+            # A retired shard's old chain must not resurrect into the
+            # NEW (empty) slot range: the handoff already moved that
+            # data to the survivors.
+            if os.path.isdir(s.ckpt_dir):
+                shutil.rmtree(s.ckpt_dir, ignore_errors=True)
+            s.spawn()
+            if not s.wait_announce(timeout):
+                s.stop()
+                self._event("reshard_failed", kind="grow", shard=sid,
+                            error="spawn timeout")
+                return None
+            self.shards.append(s)
+            self.num_shards += 1
+            self.capacity += self.shard_capacity
+            self.grows += 1
+            self.write_endpoints()
+            self._announce_shard(s)
+            self._event("reshard_done", kind="grow", shard=sid,
+                        shards=self.num_shards, transferred=0,
+                        lost=0, digest_ok=True)
+            return sid
+        finally:
+            self._end_reshard()
+
+    def retire(self, drain_grace_s: float = 0.5,
+               timeout: float = 60.0) -> Optional[int]:
+        """Merge: remove the HIGHEST shard via a digest-proven handoff —
+        announce it draining (clients stop routing adds), let in-flight
+        adds settle, fingerprint the live state (content crc), SIGTERM
+        (the clean-stop path commits a final chain), restore the chain
+        and PROVE it bit-exact against the live fingerprint, then re-add
+        every held transition (priorities recovered from the p^α masses)
+        into the survivors oldest-first.  Returns the retired sid, or
+        None when the fleet is at one shard / a reshard is in flight /
+        the proof failed (the shard respawns and the fleet stays put —
+        an unproven handoff never discards data)."""
+        if not self._begin_reshard():
+            return None
+        if self.num_shards <= 1:
+            self._end_reshard()
+            return None
+        s = self.shards[-1]
+        sid = s.shard_id
+        try:
+            if not s.alive() or sid in self.quarantined:
+                self._event("reshard_failed", kind="retire", shard=sid,
+                            error="shard not serving")
+                return None
+            self._event("reshard_started", kind="retire", shard=sid,
+                        shards_from=self.num_shards,
+                        shards_to=self.num_shards - 1)
+            self._announce_shard(s, draining=True)
+            time.sleep(max(0.0, drain_grace_s))
+            # Live fingerprint — the proof anchor the restored chain
+            # must reproduce bit for bit.
+            src = ShardClient(
+                sid, s.host, s.port, token=self.token,
+                client_id=(os.getpid() << 16) ^ secrets.randbits(16),
+                incarnation=s.incarnation, codec=self.codec,
+            )
+            try:
+                src_digest = src.digest(with_crc=True,
+                                        timeout=min(30.0, timeout))
+            finally:
+                src.close()
+            # Clean stop: SIGTERM → server.close() → final committed
+            # chain save (the shard CLI's teardown contract).
+            self._retiring = sid
+            s.stop(timeout=timeout)
+            restored = self._restore_shard_state(s)
+            d = restored.digest(with_crc=True)
+            digest_ok = all(
+                int(d[k]) == int(src_digest[k])
+                for k in ("count", "cursor", "size", "crc")
+            ) and abs(d["total_mass"] - src_digest["total_mass"]) <= 1e-6
+            if not digest_ok:
+                # Unproven chain: put the shard BACK (its chain is still
+                # the newest committed state) and abort the merge.
+                self._event("reshard_failed", kind="retire", shard=sid,
+                            error="handoff digest mismatch",
+                            src=src_digest, restored=d)
+                self.respawn(sid, timeout=timeout)
+                self._announce_shard(s, draining=False)
+                return None
+            # Geometry shrinks BEFORE the transfer: clients must never
+            # route new work at the vacated range while its transitions
+            # re-enter under survivor indices.
+            self.shards.pop()
+            self.num_shards -= 1
+            self.capacity -= self.shard_capacity
+            self.write_endpoints()
+            if self._announcer is not None:
+                self._announcer.remove_member(f"replay/shard{sid}")
+                self._announcer.poke()
+            transferred, lost = self._transfer_out(restored, timeout)
+            self.retires += 1
+            # Park the consumed chain: a later grow() of this sid must
+            # start EMPTY, not resurrect handed-off data.
+            parked = s.ckpt_dir + ".retired"
+            shutil.rmtree(parked, ignore_errors=True)
+            try:
+                os.rename(s.ckpt_dir, parked)
+            except OSError:
+                shutil.rmtree(s.ckpt_dir, ignore_errors=True)
+            self._event("reshard_done", kind="retire", shard=sid,
+                        shards=self.num_shards, transferred=transferred,
+                        lost=lost, digest_ok=True,
+                        crc=int(src_digest["crc"]),
+                        count=int(src_digest["count"]))
+            return sid
+        except Exception as e:  # noqa: BLE001 — a failed handoff is a typed event; the fleet must survive it
+            self._event("reshard_failed", kind="retire", shard=sid,
+                        error=f"{type(e).__name__}: {e}")
+            return None
+        finally:
+            self._retiring = None
+            self._end_reshard()
+
+    def _restore_shard_state(self, s: ReplayShardProcess):
+        """The retired shard's committed chain, restored in-process (a
+        plain dense replay — the tiered store materializes identically
+        through ``get``, so digests stay comparable)."""
+        from ape_x_dqn_tpu.replay.buffer import PrioritizedReplay
+        from ape_x_dqn_tpu.utils.checkpoint_inc import (
+            load_incremental_replay,
+        )
+
+        replay = PrioritizedReplay(self.shard_capacity, self.obs_shape,
+                                   priority_exponent=self.alpha)
+        load_incremental_replay(s.ckpt_dir, replay, fallback=False)
+        return replay
+
+    def _transfer_out(self, replay, timeout: float) -> Tuple[int, int]:
+        """Re-add every transition of a restored (already-removed) shard
+        into the survivors, oldest-first so survivor ring evictions —
+        if any — fall on the oldest data, the loss order replay already
+        lives with.  Returns (transferred, lost)."""
+        size = int(replay.size())
+        if size == 0:
+            return 0, 0
+        state = replay.state_dict()
+        count, cursor = int(state["count"]), int(state["cursor"])
+        if count > replay.capacity:      # wrapped ring: oldest at cursor
+            order = (cursor + np.arange(size)) % size
+        else:
+            order = np.arange(size)
+        mass = np.asarray(state["tree_priorities"], np.float64)
+        if self.alpha > 0:
+            prio = np.power(np.maximum(mass, 1e-12), 1.0 / self.alpha)
+        else:
+            prio = np.ones_like(mass)
+        clients = [
+            ShardClient(
+                p.shard_id, p.host, p.port, token=self.token,
+                client_id=(os.getpid() << 16) ^ secrets.randbits(16),
+                incarnation=p.incarnation, codec=self.codec,
+            )
+            for p in self.shards
+        ]
+        transferred = lost = 0
+        try:
+            batch = 256
+            for pos, off in enumerate(range(0, size, batch)):
+                rows = order[off:off + batch]
+                body = encode_body(
+                    {
+                        "prio": prio[rows],
+                        "obs": np.asarray(state["obs"])[rows],
+                        "action": np.asarray(state["action"])[rows],
+                        "reward": np.asarray(state["reward"])[rows],
+                        "discount": np.asarray(state["discount"])[rows],
+                        "next_obs": np.asarray(state["next_obs"])[rows],
+                    },
+                    codec=_CODEC_IDS[self.codec], dedup=True,
+                )
+                sent = False
+                for attempt in range(len(clients)):
+                    c = clients[(pos + attempt) % len(clients)]
+                    try:
+                        c.request(OP_ADD, body, timeout=timeout)
+                        sent = True
+                        break
+                    except (ReplayShardUnavailable, ReplayRpcError):
+                        continue
+                if sent:
+                    transferred += len(rows)
+                else:
+                    lost += len(rows)
+        finally:
+            for c in clients:
+                c.close()
+        return transferred, lost
 
     def kill(self, shard_id: int) -> dict:
         s = self.shards[shard_id]
@@ -1868,8 +2423,13 @@ class ReplayServiceFleet:
 
         reported: set = set()
         while not self._stop.wait(0.1):
-            for s in self.shards:
+            for s in list(self.shards):
                 sid = s.shard_id
+                if sid == self._retiring:
+                    # Mid-handoff: the retire path owns this shard's
+                    # lifecycle — a supervisor respawn here would fork
+                    # the slot range's history.
+                    continue
                 if s.alive() or sid in self.quarantined:
                     reported.discard(sid)
                     continue
@@ -1889,14 +2449,18 @@ class ReplayServiceFleet:
                         self._respawn_policy.on_death(sid)
 
     def stats(self) -> dict:
+        shards = list(self.shards)
         return {
             "shards": self.num_shards,
-            "alive": sum(1 for s in self.shards if s.alive()),
+            "alive": sum(1 for s in shards if s.alive()),
             "respawns": self.respawns,
             "kills": self.kills,
+            "grows": self.grows,
+            "retires": self.retires,
+            "resharding": self.resharding(),
             "quarantined": sorted(self.quarantined),
             "incarnations": {
-                str(s.shard_id): s.incarnation for s in self.shards
+                str(s.shard_id): s.incarnation for s in shards
             },
         }
 
@@ -1904,7 +2468,10 @@ class ReplayServiceFleet:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
-        for s in self.shards:
+        if self._announcer is not None:
+            self._announcer.close(leave=True)
+            self._announcer = None
+        for s in list(self.shards):
             s.stop()
 
 
@@ -1936,6 +2503,10 @@ def main(argv=None) -> int:
     ap.add_argument("--ckpt-dir", default="")
     ap.add_argument("--save-every-s", type=float, default=2.0)
     ap.add_argument("--base-every", type=int, default=16)
+    ap.add_argument("--hot-frame-budget-bytes", type=int, default=0,
+                    help="replay.service_hot_frame_budget_bytes: >0 hosts "
+                    "the shard's replay on the tiered (spill-backed) "
+                    "store, capping hot frame DRAM at this many bytes")
     ap.add_argument("--max-request-bytes", type=int,
                     default=_DEFAULT_MAX_FRAME)
     ap.add_argument("--rpc-delay-ms", type=float, default=0.0)
@@ -1946,8 +2517,16 @@ def main(argv=None) -> int:
     from ape_x_dqn_tpu.replay.buffer import PrioritizedReplay
 
     obs_shape = tuple(int(d) for d in args.obs_shape.split(","))
+    tier_kw = {}
+    if args.hot_frame_budget_bytes > 0:
+        # Spill-backed shard: the cold files live beside the chain (one
+        # spill dir per incarnation-independent shard home).
+        spill_dir = os.path.join(args.ckpt_dir or ".", "spill")
+        os.makedirs(spill_dir, exist_ok=True)
+        tier_kw = dict(hot_frame_budget_bytes=args.hot_frame_budget_bytes,
+                       spill_dir=spill_dir)
     replay = PrioritizedReplay(args.capacity, obs_shape,
-                               priority_exponent=args.alpha)
+                               priority_exponent=args.alpha, **tier_kw)
     # Recovery: a respawned incarnation walks its own chain back to the
     # newest committed state — bit-exact (digest announced below) or a
     # typed degraded_restore from the fallback rungs, never silent.

@@ -877,6 +877,39 @@ class AsyncPipeline:
                 "autopilot", self.autopilot.state
             )
             self.register_jsonl_section("autopilot", self.autopilot.state)
+        # --- fleet discovery plane (fleet.*) --------------------------------
+        # Under ``fleet.discovery = "registry"`` the trainer hosts the
+        # run-token-scoped membership registry: replay shards, serving
+        # replicas and worker hosts JOIN over the announce wire
+        # (F_FANN/F_FREP) instead of the driver plumbing ports through
+        # files and pipes, and the in-process aggregator adopts
+        # membership as its scrape-target truth.  The bound port + token
+        # ride a JSONL event so drivers and tools can hand them to their
+        # fleets (the endpoints file stays as the compat fallback).
+        self.fleet_registry = None
+        if self.cfg.fleet.discovery == "registry":
+            import secrets
+
+            from ape_x_dqn_tpu.fleet.registry import FleetRegistry
+
+            self.fleet_registry = FleetRegistry(
+                token=secrets.randbits(63) or 1,
+                host=self.cfg.fleet.registry_host,
+                port=self.cfg.fleet.registry_port,
+                ttl_s=self.cfg.fleet.ttl_s,
+                on_event=self.logger.event,
+            ).serve()
+            self.logger.event(
+                "fleet_registry_listen",
+                host=self.cfg.fleet.registry_host,
+                port=self.fleet_registry.port,
+                token=self.fleet_registry.token,
+            )
+            self.obs_registry.register_provider(
+                "fleet_membership", self.fleet_registry.snapshot
+            )
+            if self.autopilot_aggregator is not None:
+                self.autopilot_aggregator.bind_registry(self.fleet_registry)
 
     def _build_central_serving(self) -> None:
         """Resolve the central-inference endpoint: host an in-process
@@ -1710,6 +1743,12 @@ class AsyncPipeline:
                 self.autopilot_aggregator.close()
             except Exception:  # noqa: BLE001 — teardown best-effort
                 pass
+        if self.fleet_registry is not None:
+            try:
+                self.fleet_registry.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            self.fleet_registry = None
         if self._chaos is not None:
             try:
                 self._chaos.stop()

@@ -125,6 +125,14 @@ F_RREQ = 32        # learner → shard: one replay RPC request
 F_RREP = 33        # shard → learner: reply
 F_RERR = 34        # shard → learner: typed refusal (bad / empty / closed)
 
+# Fleet-discovery kinds (fleet/registry.py) — the fourth protocol on this
+# frame discipline: every fleet member (replay shard, serving replica,
+# remote worker host) announces itself to the run's membership registry
+# over the same header + crc/seq contract; a torn/bitflipped/wrong-token/
+# stale-incarnation announce is counted and never mutates membership.
+F_FANN = 48        # member → registry: announce / heartbeat / leave doc
+F_FREP = 49        # registry → member: membership snapshot reply
+
 # F_SERR error codes.
 E_OVERLOADED = 1   # admission control shed the request (retry later)
 E_CLOSED = 2       # server shutting down
@@ -187,6 +195,18 @@ def split_trace(payload):
 # caught it colliding with shm_ring's ring-header magic.
 RSVC_MAGIC = b"APXV"
 RSVC_ACK_MAGIC = b"APXA"
+# Fleet-discovery hello magics (fleet/registry.py): a member dialing the
+# registry leads with FLEET_MAGIC; the registry's admit ack leads with
+# FLEET_ACK_MAGIC.  Wrong-token hellos are rejected by close BEFORE any
+# framing state exists — port confusion and cross-run strays never reach
+# the membership table.
+FLEET_MAGIC = b"APXF"
+FLEET_ACK_MAGIC = b"APXG"
+# magic, version, member_id (stable per member name), incarnation, token
+FLEET_HELLO = struct.Struct("<4sIqqq")
+FLEET_HELLO_VERSION = 1
+# magic, version, token, registry incarnation
+FLEET_ACK = struct.Struct("<4sIqq")
 SERVE_HELLO = struct.Struct("<4sI")
 # wid, attempt, token, codec, flags (HELLO_FLAG_*; was pad — old hellos
 # read as flags=0, the bit-identical-wire gate for tracing).

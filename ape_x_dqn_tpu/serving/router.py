@@ -45,8 +45,13 @@ import sys
 import threading
 import time
 import urllib.request
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
+from ape_x_dqn_tpu.fleet.registry import (
+    FleetAnnouncer,
+    member_doc,
+    member_id_for,
+)
 from ape_x_dqn_tpu.runtime.net import Backoff, NetTransport
 
 _SPLICE_CHUNK = 1 << 16
@@ -501,7 +506,9 @@ class ServingFleet:
                  listen_port: int = 0, probe_interval_s: float = 0.5,
                  replica_args: Optional[List[str]] = None,
                  respawn: bool = True, on_event: Optional[Callable] = None,
-                 env: Optional[dict] = None):
+                 env: Optional[dict] = None,
+                 registry_addr: Optional[Tuple[str, int]] = None,
+                 registry_token: int = 0, heartbeat_s: float = 1.0):
         if replicas < 1:
             raise ValueError("replicas must be >= 1")
         self._on_event = on_event
@@ -539,6 +546,20 @@ class ServingFleet:
         self._retiring: Dict[int, tuple] = {}   # rid -> (t0, grace_s)
         self.spawned = 0
         self.retires = 0
+        # Fleet discovery plane (optional): when a registry address is
+        # given, every replica that reaches rotation is ANNOUNCED as a
+        # serving_replica member (varz_url carried in the doc), so the
+        # aggregator adopts it from membership — no driver hand-carries
+        # obs ports, and an autopilot-spawned replica is discovered the
+        # same way the seed ones are.
+        self._announcer: Optional[FleetAnnouncer] = None
+        if registry_addr is not None:
+            self._announcer = FleetAnnouncer(
+                registry_addr[0], int(registry_addr[1]),
+                token=int(registry_token),
+                member_id=member_id_for(f"serving-fleet-{os.getpid()}"),
+                heartbeat_s=float(heartbeat_s), on_event=on_event,
+            )
 
     @property
     def port(self) -> int:
@@ -592,6 +613,8 @@ class ServingFleet:
             rep.wait_ready(timeout=max(1.0, deadline - time.monotonic()))
             self._register(rep)
         self.router.start()
+        if self._announcer is not None:
+            self._announcer.start()
         return self
 
     def _register(self, rep: ReplicaProcess) -> None:
@@ -599,6 +622,19 @@ class ServingFleet:
             rep.rid, "127.0.0.1", rep.port,
             health_url=rep.health_url(), alive_fn=rep.alive,
         )
+        self._announce_replica(rep)
+
+    def _announce_replica(self, rep: ReplicaProcess) -> None:
+        if self._announcer is None or rep.port is None:
+            return
+        varz = "" if rep.obs_port is None else \
+            f"http://{self._listen_host}:{rep.obs_port}/varz"
+        self._announcer.set_member(member_doc(
+            f"serving/replica{rep.rid}", "serving_replica",
+            host=self._listen_host, port=int(rep.port),
+            incarnation=rep.attempt + 1, varz_url=varz,
+        ))
+        self._announcer.poke()
 
     def _supervise(self) -> None:
         """Pump the hub's accept loop, respawn dead replicas (drain-now
@@ -732,11 +768,16 @@ class ServingFleet:
             self._spawning.pop(rid, None)
             self.retires += 1
         self.router.remove_endpoint(rid)
+        if self._announcer is not None:
+            self._announcer.remove_member(f"serving/replica{rid}")
+            self._announcer.poke()
         self._event("replica_retired", rid=rid)
         return rid
 
     def stop(self) -> None:
         self._stop.set()
+        if self._announcer is not None:
+            self._announcer.close(leave=True)
         if self._super is not None:
             self._super.join(timeout=5.0)
         for rep in self.replicas.values():

@@ -151,6 +151,11 @@ def main(argv=None) -> int:
             "autopilot.hold_opposite_s=6",
             f"autopilot.serving_idle_qps_per_replica={IDLE_PER_REPLICA}",
             "autopilot.idle_window_s=8",
+            # Discovery plane: the trainer hosts the membership registry;
+            # serving replicas ANNOUNCE themselves (fleet/registry.py) and
+            # the aggregator adopts them from membership — no driver-side
+            # endpoint polling anywhere in this smoke.
+            "fleet.discovery=registry",
         ])
         logger = MetricLogger(path=trainer_log)
         pipe = AsyncPipeline(cfg, logger=logger, log_every=500)
@@ -158,9 +163,17 @@ def main(argv=None) -> int:
         agg = pipe.autopilot_aggregator
 
         # -- serving fleet: 1 replica, sleep-bound service time --------
+        # Registered with the trainer-hosted membership registry: every
+        # replica that reaches rotation announces itself (varz_url in
+        # the member doc) and the aggregator adopts it from membership —
+        # an autopilot-spawned replica is discovered exactly like the
+        # seed one, with no endpoint-sync polling in this driver.
         fleet = ServingFleet(
             replicas=1, probe_interval_s=0.5,
             on_event=lambda kind, **f: logger.event(kind, **f),
+            registry_addr=("127.0.0.1", pipe.fleet_registry.port),
+            registry_token=pipe.fleet_registry.token,
+            heartbeat_s=0.5,
             replica_args=[
                 "--set", "network=mlp", "--set", "env.name=chain:6",
                 "--set", "serving.max_batch=1",
@@ -174,21 +187,6 @@ def main(argv=None) -> int:
         fleet.start(timeout=min(240.0, remaining()))
         pipe.autopilot.attach_serving(
             ServingFleetActuator(fleet, drain_grace_s=2.0))
-
-        def sync_replica_endpoints() -> None:
-            # Keep the sensor's endpoint set in step with the elastic
-            # fleet: register announced obs ports, forget retired rids.
-            for rid, rep in list(fleet.replicas.items()):
-                name = f"replica{rid}"
-                if rid in fleet.retired:
-                    agg.remove_endpoint(name)
-                elif rep.obs_port is not None:
-                    agg.add_varz(
-                        name, f"http://127.0.0.1:{rep.obs_port}/varz",
-                        kind="replica",
-                    )
-
-        sync_replica_endpoints()
 
         # -- trainer thread + loadgen schedule -------------------------
         def _run():
@@ -219,7 +217,6 @@ def main(argv=None) -> int:
             deadline = time.monotonic() + min(timeout,
                                               max(1.0, remaining()))
             while time.monotonic() < deadline:
-                sync_replica_endpoints()
                 if run_err:
                     raise RuntimeError(f"trainer died: {run_err[0]}")
                 if cond():
@@ -400,6 +397,13 @@ def main(argv=None) -> int:
             and fleet.retires == len(act_dn_srv),
             "zero_torn_records": pool.transport.summary()[
                 "torn_records"] <= 1,   # the SIGKILL drill's salvage tear
+            # Discovery plane: the replicas reached the sensor through
+            # the membership registry (announce channel), and the
+            # retired one LEFT it — no driver-side endpoint polling.
+            "replicas_discovered_via_membership":
+            "serving/replica0" in (final_rollup.get("endpoints") or {})
+            and (final_rollup.get("membership") or {}).get("version", 0)
+            > 0,
             "trainer_alive_throughout": not run_err,
         }
         verdict = {

@@ -123,7 +123,8 @@ def render_fleet(snap: dict) -> str:
         f"serving p99 {_num(srv.get('p99_ms'))} ms "
         f"qps {_num(srv.get('qps'))}  "
         f"inference rtt p99 {_num(inf.get('rtt_p99_ms_max'))} ms  "
-        f"replay op p95 {_num(rep.get('op_p95_ms'), '{:.2f}')} ms  "
+        f"replay op p95 {_num(rep.get('op_p95_ms'), '{:.2f}')} ms "
+        f"add {_num(rep.get('add_qps'))}/s  "
         f"ring occ {_num(occ, '{:.3f}')}"
     )
     for kind, title in (("trainer", "hosts/trainers"), ("shard", "shards"),
@@ -154,6 +155,18 @@ def render_fleet(snap: dict) -> str:
                 f" {name:<16} {'up  ' if e.get('alive') else 'DOWN':<5}"
                 f"fails {e.get('scrape_failures', 0):>4}  " + extra
             )
+    mem = fleet.get("membership")
+    if mem:
+        draining = mem.get("draining") or []
+        by_kind = mem.get("by_kind") or {}
+        kinds = " ".join(f"{k}:{by_kind[k]}" for k in sorted(by_kind))
+        lines.append(
+            f"-- membership v{mem.get('version', 0)}  "
+            f"{mem.get('members', 0)} members ({kinds})  "
+            f"adopted {mem.get('adopted_endpoints', 0)} eps "
+            f"({mem.get('adopts', 0)} adopts)  "
+            + (f"DRAINING[{','.join(draining)}]" if draining else "steady")
+        )
     rules = (slo.get("rules") or {})
     if rules:
         lines.append(f"-- slo rules ({len(rules)}) " + "-" * 40)

@@ -91,14 +91,27 @@
 #         kill-half-the-workers quarantines a wid, it must grow the
 #         reserved wid on the same ε-ladder partition until the windowed
 #         age-of-experience p95 re-holds (tools/autopilot_smoke.py).
-# Gate 14: apexlint — the repo's static invariant checkers
+# Gate 14: elastic-replay smoke — the replay service as the third
+#         autopilot-governed fleet, on the fleet discovery plane: a
+#         standalone membership registry, a 2-shard replay fleet that
+#         ANNOUNCES every shard, a from_registry client and a
+#         bind_registry aggregator (no endpoints file in the driver
+#         anywhere).  At the 2-shard floor the idle impulse must be
+#         suppressed at_min with zero decisions; under ingest pressure
+#         the per-shard add-QPS SLO must breach and the autopilot must
+#         grow 2->3 (membership propagating the new shard to client and
+#         sensor); when ingest stops, the idle burn window must retire
+#         the shard through the digest-proven drain -> fingerprint ->
+#         restore -> prove -> re-add handoff with ZERO lost transitions,
+#         the client sampling throughout (tools/elastic_replay_smoke.py).
+# Gate 15: apexlint — the repo's static invariant checkers
 #         (ape_x_dqn_tpu/analysis/ + tools/lint.py; docs/INVARIANTS.md):
 #         import-lightness of the no-jax child modules, the wire
 #         kind/magic registry, config coverage, metrics-doc coverage,
 #         shm discipline, typed-error discipline.  Purely static (~2 s;
 #         hard budget 20 s), fails on any finding NEW relative to the
 #         committed baseline.
-# Gate 15: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
+# Gate 16: the ROADMAP.md "Tier-1 verify" command verbatim; if the ROADMAP
 #         command changes, change it HERE too (they must stay
 #         character-identical modulo this wrapper's cd).
 cd "$(dirname "$0")/.." || exit 1
@@ -115,5 +128,6 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/replay_svc_smoke.py > /tmp/
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/central_inference_smoke.py > /tmp/_t1_central.log 2>&1 || { echo "central-inference smoke FAILED:"; cat /tmp/_t1_central.log; exit 1; }
 timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/fleet_obs_smoke.py > /tmp/_t1_fleet.log 2>&1 || { echo "fleet-obs smoke FAILED:"; cat /tmp/_t1_fleet.log; exit 1; }
 timeout -k 10 500 env JAX_PLATFORMS=cpu python tools/autopilot_smoke.py > /tmp/_t1_autopilot.log 2>&1 || { echo "autopilot smoke FAILED:"; cat /tmp/_t1_autopilot.log; exit 1; }
+timeout -k 10 320 python tools/elastic_replay_smoke.py > /tmp/_t1_ereplay.log 2>&1 || { echo "elastic-replay smoke FAILED:"; cat /tmp/_t1_ereplay.log; exit 1; }
 timeout -k 5 20 python -m tools.lint --fail-on-new > /tmp/_t1_lint.log 2>&1 || { echo "apexlint gate FAILED:"; cat /tmp/_t1_lint.log; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
