@@ -257,7 +257,12 @@ class AutopilotController:
             return None
         if not srv.get("replicas"):
             return None
-        qps = srv.get("qps")
+        # Prefer the timeline's trailing-window rate over the
+        # instantaneous scrape-to-scrape delta: one quiet sweep must not
+        # read as idleness and shrink a loaded fleet.
+        qps = (srv.get("window") or {}).get("qps")
+        if qps is None:
+            qps = srv.get("qps")
         if qps is None:
             return None
         return float(qps) / max(1, fleet.actuator.size())
@@ -269,7 +274,9 @@ class AutopilotController:
             return None
         if not rep.get("shards_alive"):
             return None
-        qps = rep.get("add_qps")
+        qps = (rep.get("window") or {}).get("add_qps")
+        if qps is None:
+            qps = rep.get("add_qps")
         if qps is None:
             return None
         return float(qps) / max(1, fleet.actuator.size())

@@ -530,6 +530,25 @@ class ObsConfig:
     # Minimum window samples before ANY transition (one bad scrape is
     # not a breach; one good one is not a recovery).
     fleet_slo_min_samples: int = 3
+    # --- flight-data recorder (obs/timeline.py) ---
+    # Timeline directory: every aggregator sweep appends one compacted
+    # delta record to a CRC-framed on-disk ring here, giving the run a
+    # durable fleet time-series (windowed queries, SLO-window rebuild on
+    # aggregator respawn, obs_top --timeline, tools/obs_diff.py).
+    # "auto" puts it under <learner.checkpoint_dir>/timeline when
+    # checkpointing is enabled and disables it otherwise (the
+    # postmortem_dir discipline); an explicit path always enables; None
+    # disables the recorder.
+    timeline_dir: Optional[str] = "auto"
+    # Total on-disk budget: oldest committed segments are pruned once
+    # the ring exceeds this many bytes (bounded by construction).
+    timeline_max_bytes: int = 16 << 20
+    # Segment rotation size: a segment is fsynced and committed into the
+    # manifest (tmp+rename) once it reaches this many bytes.
+    timeline_segment_bytes: int = 1 << 20
+    # In-memory tail kept for windowed queries on the sweep path,
+    # seconds; disk remains the source of truth for older windows.
+    timeline_tail_keep_s: float = 600.0
 
 
 @dataclasses.dataclass
@@ -867,6 +886,13 @@ class ApexConfig:
              "0 <= clear <= burn <= 1"),
             (o.fleet_slo_min_samples >= 1,
              "obs.fleet_slo_min_samples must be >= 1"),
+            (o.timeline_segment_bytes >= 1 << 12,
+             "obs.timeline_segment_bytes must be >= 4 KiB (a segment "
+             "must hold at least a few records before rotating)"),
+            (o.timeline_max_bytes >= o.timeline_segment_bytes,
+             "obs.timeline_max_bytes must be >= obs.timeline_segment_bytes"),
+            (o.timeline_tail_keep_s > 0.0,
+             "obs.timeline_tail_keep_s must be > 0"),
             (s.max_batch >= 1, "serving.max_batch must be >= 1"),
             (s.max_wait_ms >= 0.0, "serving.max_wait_ms must be >= 0"),
             (s.queue_capacity >= s.max_batch,

@@ -7,6 +7,11 @@ attach.  Endpoints:
 
   * ``/metrics`` — Prometheus text exposition from the registry
     (counters/gauges/histogram quantiles + flattened provider dicts).
+    Exposition correctness is pinned by tests/test_timeline.py: the
+    format's exact non-finite spellings (``NaN``/``+Inf``/``-Inf``,
+    never python's ``nan``/``inf``), HELP text with newlines and
+    backslashes escaped onto one line, and every summary shipping
+    ``_sum`` alongside ``_count`` with quantiles in order.
   * ``/varz``    — the full JSON snapshot (what ``tools/obs_top.py``
     scrapes).  ``?trace=1`` additionally fires the on-demand
     ``jax.profiler`` hook (obs/trace.py) and reports its status inline.

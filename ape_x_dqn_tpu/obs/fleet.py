@@ -52,6 +52,8 @@ import time
 import urllib.request
 import zlib
 from collections import OrderedDict, deque
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ape_x_dqn_tpu.utils.metrics import (
@@ -459,9 +461,19 @@ class FleetAggregator:
                  scrape_timeout_s: float = 2.0,
                  slo: Optional[SloEngine] = None,
                  window_s: float = 30.0,
+                 scrape_workers: int = 8,
                  emit=None, jsonl_stream=None):
         self._interval = float(scrape_interval_s)
         self._timeout = float(scrape_timeout_s)
+        self._window_s = float(window_s)
+        # Concurrent scrape plane: endpoints are fetched on a bounded
+        # pool under one TOTAL-cycle deadline, so a dead member costs the
+        # sweep one timeout, not N of them — the serial loop stretched
+        # cadence by N×timeout and skewed every windowed SLO burn rate.
+        self._workers = max(1, int(scrape_workers))
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._inflight: Dict[str, object] = {}   # name -> still-running Future
+        self.timeline = None                      # attach_timeline()
         # Windowed twins of the cumulative merged histograms (the values
         # the SLO extractors prefer — see _BucketWindow).
         self._age_window = _BucketWindow(window_s=window_s)
@@ -658,11 +670,65 @@ class FleetAggregator:
         finally:
             client.close()
 
+    def _fetch(self, ep: _Endpoint) -> dict:
+        if ep.snapshot_fn is not None:
+            return dict(ep.snapshot_fn())
+        if ep.kind == "shard":
+            return self._scrape_shard(ep)
+        return self._scrape_http(ep)
+
+    def _scrape_all(self, eps: List[_Endpoint]) -> List[tuple]:
+        """Fetch every endpoint concurrently (bounded pool) under one
+        total-cycle deadline.  Returns ``(ep, snapshot_or_None,
+        error_or_None)`` in endpoint order.  An endpoint whose PREVIOUS
+        fetch is still wedged (a hang the socket timeout can't see —
+        e.g. a snapshot_fn stuck on a lock) is skipped and counted as a
+        failure instead of stacking another worker behind it; the
+        straggler's eventual result is discarded."""
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._workers, thread_name_prefix="fleet-scrape"
+            )
+        # One endpoint timeout of budget for everyone at once, plus
+        # pool-queueing slack when the fleet outnumbers the workers.
+        waves = (len(eps) + self._workers - 1) // max(1, self._workers)
+        deadline = time.monotonic() + self._timeout * max(1, waves) + 0.25
+        futs: Dict[str, object] = {}
+        results: List[tuple] = []
+        for ep in eps:
+            old = self._inflight.get(ep.name)
+            if old is not None and not old.done():
+                results.append((ep, None,
+                                "ScrapeStuck: previous scrape still in flight"))
+                continue
+            self._inflight.pop(ep.name, None)
+            futs[ep.name] = self._pool.submit(self._fetch, ep)
+        for ep in eps:
+            fut = futs.get(ep.name)
+            if fut is None:
+                continue
+            try:
+                snap = fut.result(
+                    timeout=max(0.0, deadline - time.monotonic())
+                )
+                results.append((ep, snap, None))
+            except _FutureTimeout:
+                self._inflight[ep.name] = fut
+                results.append((ep, None,
+                                "ScrapeDeadline: cycle deadline exceeded"))
+            except Exception as e:  # noqa: BLE001 — ANY scrape fault = endpoint down, never a sweep crash
+                results.append((ep, None, f"{type(e).__name__}: {e}"))
+        order = {ep.name: i for i, ep in enumerate(eps)}
+        results.sort(key=lambda r: order.get(r[0].name, len(order)))
+        return results
+
     def scrape_once(self, now: Optional[float] = None) -> dict:
-        """One full sweep: scrape every endpoint, rebuild the rollup,
-        evaluate the SLO rules.  Returns the rollup (also kept for the
-        /varz provider).  A failing endpoint is marked down and the sweep
-        continues — the fleet view never dies of a member's death."""
+        """One full sweep: scrape every endpoint (concurrently, one
+        total-cycle deadline), rebuild the rollup, evaluate the SLO
+        rules, append the sweep to the timeline when one is attached.
+        Returns the rollup (also kept for the /varz provider).  A
+        failing endpoint is marked down and the sweep continues — the
+        fleet view never dies of a member's death."""
         if self._registry_fn is not None:
             try:
                 self.adopt_membership(self._registry_fn())
@@ -672,21 +738,14 @@ class FleetAggregator:
         now = time.monotonic() if now is None else float(now)
         with self._lock:
             eps = list(self._eps.values())
-        for ep in eps:
+        for ep, snap, err in self._scrape_all(eps):
             self.scrapes += 1
-            try:
-                if ep.snapshot_fn is not None:
-                    snap = dict(ep.snapshot_fn())
-                elif ep.kind == "shard":
-                    snap = self._scrape_shard(ep)
-                else:
-                    snap = self._scrape_http(ep)
-            except Exception as e:  # noqa: BLE001 — ANY scrape fault = endpoint down, never a sweep crash
+            if err is not None:
                 self.scrape_failures += 1
                 ep.scrape_failures += 1
                 ep.consecutive_failures += 1
                 ep.alive = False
-                ep.last_error = f"{type(e).__name__}: {e}"
+                ep.last_error = err
                 continue
             ep.alive = True
             ep.consecutive_failures = 0
@@ -696,7 +755,13 @@ class FleetAggregator:
         rollup = self._merge(eps, now)
         with self._lock:
             self._rollup = rollup
-        self.slo.evaluate(rollup, now=now)
+        slo_status = self.slo.evaluate(rollup, now=now)
+        if self.timeline is not None:
+            try:
+                self.timeline.append_sweep(rollup, slo_status, now=now)
+                self._lift_timeline_windows(rollup, now)
+            except Exception:  # noqa: BLE001 — the recorder must never kill the sweep
+                pass
         self.sweeps += 1
         self.last_sweep_t = time.monotonic()
         if self._jsonl is not None:
@@ -713,6 +778,38 @@ class FleetAggregator:
             except (OSError, ValueError):
                 pass
         return rollup
+
+    # -- timeline (flight-data recorder) -----------------------------------
+
+    def attach_timeline(self, store, rebuild: bool = True) -> None:
+        """Attach a :class:`~ape_x_dqn_tpu.obs.timeline.TimelineStore`
+        (duck-typed — fleet.py stays import-light): every sweep appends
+        one compacted delta record, and — the respawn story — the SLO
+        engine's burn/clear windows and rule states are REBUILT from the
+        timeline tail right now, so a restarted aggregator resumes the
+        previous incarnation's alarm state instead of opening a blind
+        window that false-clears a live breach."""
+        self.timeline = store
+        if rebuild:
+            try:
+                store.rebuild_slo(self.slo)
+            except Exception:  # noqa: BLE001 — a corrupt tail degrades to a cold start, never a crash
+                pass
+
+    def _lift_timeline_windows(self, rollup: dict, now: float) -> None:
+        """Windowed rates from the recorder onto the rollup: the
+        scrape-to-scrape ``qps`` / ``add_qps`` are instantaneous (one
+        quiet sweep reads as idleness); these are the smoothed trailing-
+        window twins the autopilot's idle rules prefer."""
+        win = self._window_s
+        qps = self.timeline.rate("serving_replies", win, now=now)
+        if qps is not None:
+            (rollup.get("serving") or {}).setdefault("window", {})[
+                "qps"] = round(qps, 2)
+        add = self.timeline.rate("replay_added", win, now=now)
+        rep = rollup.get("replay")
+        if add is not None and isinstance(rep, dict):
+            rep["window"] = {"add_qps": round(add, 2), "window_s": win}
 
     # -- merge arithmetic --------------------------------------------------
 
@@ -779,6 +876,13 @@ class FleetAggregator:
         shard_counters: dict = {}
         shards_alive = 0
         replay_add_qps = 0.0
+        # Per-param_version serving telemetry (ROADMAP item 3's canary
+        # sensor) + the newest bucket exemplars, merged across replicas.
+        version_counts: Dict[str, int] = {}
+        version_buckets: Dict[str, dict] = {}
+        serving_exemplars: dict = {}
+        op_exemplars: dict = {}
+        rtt_exemplars: dict = {}
         inference_p99: List[float] = []
         inference_stall = 0.0
         inference_replies = 0
@@ -797,6 +901,8 @@ class FleetAggregator:
                     shard_ms_buckets = merge_bucket_dicts(
                         shard_ms_buckets, op.get("buckets") or {}
                     )
+                    if isinstance(op.get("exemplars"), dict):
+                        op_exemplars.update(op["exemplars"])
                     shard_counters = merge_counter_maps(
                         shard_counters,
                         {k: snap[k] for k in _SHARD_SUM_KEYS if k in snap},
@@ -832,6 +938,8 @@ class FleetAggregator:
                 inference_p99.append(float(rtt.get("p99_ms", 0.0)))
                 inference_stall += float(inf.get("stall_ms", 0.0))
                 inference_replies += int(inf.get("replies", 0))
+            if isinstance(inf.get("rtt_exemplars"), dict):
+                rtt_exemplars.update(inf["rtt_exemplars"])
             snet = snap.get("serving_net") \
                 or (snap.get("serving") or {}).get("net")
             if isinstance(snet, dict) and ep.kind == "replica":
@@ -842,6 +950,18 @@ class FleetAggregator:
                 )
                 lat = snet.get("latency") or {}
                 serving_count += int(lat.get("count", 0))
+                if isinstance(snet.get("latency_exemplars"), dict):
+                    serving_exemplars.update(snet["latency_exemplars"])
+                for ver, row in (snet.get("by_version") or {}).items():
+                    if not isinstance(row, dict):
+                        continue
+                    ver = str(ver)
+                    version_counts[ver] = version_counts.get(ver, 0) \
+                        + int(row.get("replies", 0))
+                    version_buckets[ver] = merge_bucket_dicts(
+                        version_buckets.get(ver, {}),
+                        row.get("latency_buckets") or {},
+                    )
                 replies = float(snet.get("replies", 0))
                 mark = ep.prev_qps_mark
                 if mark is not None and now > mark[0]:
@@ -897,6 +1017,7 @@ class FleetAggregator:
                 "stall_ms": round(inference_stall, 1),
                 "replies": inference_replies,
                 "trainers_reporting": len(inference_p99),
+                "rtt_exemplars": rtt_exemplars,
             },
             "serving": {
                 "replicas": serving_replicas,
@@ -912,6 +1033,21 @@ class FleetAggregator:
                 if serving_count else None,
                 "qps": round(serving_qps, 2),
                 "latency_buckets": serving_buckets,
+                # Canary sensor: the same latency split by the
+                # param_version each reply carried, fleet-merged.
+                "by_version": {
+                    ver: {
+                        "replies": version_counts.get(ver, 0),
+                        "p50_ms": round(
+                            bucket_percentile(bkts, 50) * 1e3, 3)
+                        if any(bkts.values()) else None,
+                        "p99_ms": round(
+                            bucket_percentile(bkts, 99) * 1e3, 3)
+                        if any(bkts.values()) else None,
+                    }
+                    for ver, bkts in sorted(version_buckets.items())
+                },
+                "exemplars": serving_exemplars,
                 "window": {
                     "count": srv_win_n,
                     "p50_ms": round(
@@ -933,6 +1069,7 @@ class FleetAggregator:
                     bucket_percentile(shard_ms_buckets, 95) * 1e3, 3)
                 if shard_ms_buckets else None,
                 "op_buckets": shard_ms_buckets,
+                "op_exemplars": op_exemplars,
                 **shard_counters,
             },
             "membership": dict(self._membership) if self._membership
@@ -977,6 +1114,8 @@ class FleetAggregator:
         ).set_fn(lambda: self.slo.clears)
         self.registry.register_provider("fleet", self.rollup)
         self.registry.register_provider("slo", self.slo_status)
+        if self.timeline is not None:
+            self.registry.register_provider("timeline", self.timeline.stats)
         self.health = Health(stale_after_s=max(10.0, 5 * self._interval))
         self.health.register(
             "scrape_loop", lambda: time.monotonic() - self.last_sweep_t
@@ -1013,3 +1152,13 @@ class FleetAggregator:
         if self._server is not None:
             self._server.close()
             self._server = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        if self.timeline is not None:
+            # Clean shutdown commits the active segment; a SIGKILL skips
+            # this and the next incarnation adopts the tail instead.
+            try:
+                self.timeline.close()
+            except Exception:  # noqa: BLE001 — shutdown is best-effort, the tail is adoptable
+                pass

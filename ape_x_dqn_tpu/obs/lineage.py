@@ -108,6 +108,51 @@ class TraceSpanLog:
             return {"recorded": self.recorded, "spans": list(self._spans)}
 
 
+class BucketExemplars:
+    """Newest sampled trace id per latency-histogram bucket.
+
+    The timeline store (obs/timeline.py) can say *that* a p99 spike
+    happened; an exemplar says *which request* — a ``trace_id`` whose
+    assembled cross-tier timeline shows where the milliseconds went.
+    Each record site that feeds a :class:`LatencyHistogram` mirrors the
+    traced fraction of its samples here, keyed to the SAME bucket edge
+    the count landed in (``LatencyHistogram.bucket_edge``), newest id
+    per bucket winning — Prometheus' OpenMetrics exemplar semantics,
+    without the exposition format.  A p99 query resolves its percentile
+    to a bucket edge, looks the edge up here, and hands the id to the
+    aggregator's trace timelines.
+
+    Thread-safe, bounded (one id per non-empty bucket, LRU past
+    ``max_buckets``), and free when tracing is off: a zero trace id is
+    a no-op, exactly the :class:`TraceSpanLog` gate."""
+
+    def __init__(self, hist, max_buckets: int = 64):
+        self._hist = hist
+        self._max = int(max_buckets)
+        self._by_edge: "dict[str, int]" = {}
+        self._order: deque = deque()
+        self._lock = threading.Lock()
+        self.recorded = 0
+
+    def record(self, seconds: float, trace_id: int) -> None:
+        if not trace_id:
+            return
+        edge = self._hist.bucket_edge(seconds)
+        with self._lock:
+            if edge not in self._by_edge:
+                self._order.append(edge)
+                while len(self._order) > self._max:
+                    self._by_edge.pop(self._order.popleft(), None)
+            self._by_edge[edge] = int(trace_id)
+            self.recorded += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        """{bucket_edge_label: newest trace_id} — rides the owning
+        surface's stats dict so the aggregator can lift it fleet-wide."""
+        with self._lock:
+            return dict(self._by_edge)
+
+
 class LineageTracker:
     def __init__(self, capacity: int, emit=None, max_open_traces: int = 512,
                  keep_completed: int = 16):

@@ -129,6 +129,12 @@ class Histogram:
     def count(self) -> int:
         return self._hist.count
 
+    @property
+    def sum(self) -> float:
+        """Total observed seconds (the ``_sum`` series a Prometheus
+        summary exposes next to ``_count``)."""
+        return float(self._hist._sum)
+
     def percentile(self, p: float) -> float:
         return self._hist.percentile(p)
 
@@ -149,6 +155,26 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 def _prom_name(*parts: str) -> str:
     return _NAME_RE.sub("_", "_".join(p for p in parts if p))
+
+
+def _prom_value(v: float) -> str:
+    """Prometheus sample-value rendering: the text format spells the
+    specials ``+Inf`` / ``-Inf`` / ``NaN`` — Python's ``{:g}`` renders
+    ``inf`` / ``nan``, which scrapers reject as unparseable lines."""
+    v = float(v)
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return f"{v:g}"
+
+
+def _prom_help(text: str) -> str:
+    """HELP-line escaping per the exposition format: backslash and
+    newline would otherwise break the line protocol."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _flatten(prefix: str, value, out: list) -> None:
@@ -242,19 +268,22 @@ class MetricsRegistry:
         for name, inst in sorted(instruments.items()):
             pname = _prom_name(self.prefix, name)
             if inst.help:
-                lines.append(f"# HELP {pname} {inst.help}")
+                lines.append(f"# HELP {pname} {_prom_help(inst.help)}")
             if isinstance(inst, Counter):
                 lines.append(f"# TYPE {pname} counter")
-                lines.append(f"{pname}_total {inst.value:g}")
+                lines.append(f"{pname}_total {_prom_value(inst.value)}")
             elif isinstance(inst, Gauge):
                 lines.append(f"# TYPE {pname} gauge")
-                lines.append(f"{pname} {inst.value:g}")
+                lines.append(f"{pname} {_prom_value(inst.value)}")
             elif isinstance(inst, Histogram):
                 lines.append(f"# TYPE {pname} summary")
                 for q in (0.5, 0.95, 0.99):
                     v = inst.percentile(q * 100)
                     v = v if v == v else 0.0  # NaN (empty) → 0
-                    lines.append(f'{pname}{{quantile="{q}"}} {v:g}')
+                    lines.append(
+                        f'{pname}{{quantile="{q}"}} {_prom_value(v)}'
+                    )
+                lines.append(f"{pname}_sum {_prom_value(inst.sum)}")
                 lines.append(f"{pname}_count {inst.count}")
         flat: list = []
         for name, fn in sorted(providers.items()):
@@ -263,7 +292,7 @@ class MetricsRegistry:
             except Exception:  # noqa: BLE001 — scrape must not crash
                 continue
         for pname, value in flat:
-            lines.append(f"{pname} {value:g}")
+            lines.append(f"{pname} {_prom_value(value)}")
         return "\n".join(lines) + "\n"
 
 

@@ -116,7 +116,7 @@ from ape_x_dqn_tpu.runtime.net import (
     split_trace,
     wrap_trace,
 )
-from ape_x_dqn_tpu.obs.lineage import TraceSpanLog
+from ape_x_dqn_tpu.obs.lineage import BucketExemplars, TraceSpanLog
 from ape_x_dqn_tpu.runtime.shm_ring import XP, decode_chunk, encode_chunk_parts
 from ape_x_dqn_tpu.utils.metrics import LatencyHistogram
 
@@ -337,6 +337,9 @@ class ReplayShardServer:
         # merge shard histograms bucket-wise across the fleet; plus the
         # cross-tier span log (a traced request's server-side hop).
         self.op_ms = LatencyHistogram(min_s=1e-5, max_s=120.0)
+        # Newest trace id per op-latency bucket (fleet-rollup
+        # exemplars: a replay op p95 spike links to its timeline).
+        self.op_exemplars = BucketExemplars(self.op_ms)
         self.spans = TraceSpanLog(depth=64)
         self._auto_on = False
         self._auto_idle = 0
@@ -647,7 +650,9 @@ class ReplayShardServer:
                             f"{type(e).__name__}: {e}")
         # Service latency (request verified → reply enqueued) always;
         # the cross-tier span only when the request carried a trace id.
-        self.op_ms.record(time.monotonic() - t_req)
+        op_s = time.monotonic() - t_req
+        self.op_ms.record(op_s)
+        self.op_exemplars.record(op_s, trace_id)
         self.spans.record(trace_id, f"rsvc.{_OP_NAMES.get(op, str(op))}",
                           t_req, shard=self.shard_id, op=int(op))
 
@@ -847,7 +852,8 @@ class ReplayShardServer:
             # merge shards bucket-wise; recent cross-tier spans ride the
             # same stats RPC (the shard's half of an end-to-end trace).
             "op_ms": {**self.op_ms.summary(),
-                      "buckets": self.op_ms.buckets()},
+                      "buckets": self.op_ms.buckets(),
+                      "exemplars": self.op_exemplars.snapshot()},
             "trace_spans": self.spans.snapshot(),
         }
         if self._ckpt is not None:

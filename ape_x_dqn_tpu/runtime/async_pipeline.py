@@ -862,6 +862,28 @@ class AsyncPipeline:
             self.autopilot_aggregator.add_local(
                 "trainer", self.obs_registry.snapshot, kind="trainer"
             )
+            # Flight-data recorder (obs/timeline.py): every sweep lands
+            # one delta record on disk, and attaching REBUILDS the SLO
+            # burn windows from the previous incarnation's tail — a
+            # respawned trainer resumes its alarm state, no blind window.
+            tl_dir = self._resolve_timeline_dir()
+            if tl_dir is not None:
+                from ape_x_dqn_tpu.obs.timeline import TimelineStore
+
+                try:
+                    self.autopilot_aggregator.attach_timeline(TimelineStore(
+                        tl_dir,
+                        max_bytes=self.cfg.obs.timeline_max_bytes,
+                        segment_bytes=self.cfg.obs.timeline_segment_bytes,
+                        tail_keep_s=self.cfg.obs.timeline_tail_keep_s,
+                    ))
+                    self.obs_registry.register_provider(
+                        "timeline", self.autopilot_aggregator.timeline.stats
+                    )
+                except OSError as e:
+                    # An unwritable dir degrades to no recorder — the
+                    # sweep loop and the SLO engine still run.
+                    self.logger.event("timeline_open_failed", error=str(e))
             self.autopilot = AutopilotController(
                 self.cfg.autopilot,
                 rollup_fn=self.autopilot_aggregator.rollup,
@@ -1076,6 +1098,22 @@ class AsyncPipeline:
             if self.cfg.learner.checkpoint_every:
                 return os.path.join(
                     self.cfg.learner.checkpoint_dir, "postmortem"
+                )
+            return None
+        return d
+
+    def _resolve_timeline_dir(self) -> Optional[str]:
+        """obs.timeline_dir policy — the postmortem_dir discipline:
+        explicit path wins; "auto" lands the flight-data recorder under
+        the checkpoint dir a checkpointed run already owns, and stays
+        off otherwise."""
+        import os
+
+        d = self.cfg.obs.timeline_dir
+        if d == "auto":
+            if self.cfg.learner.checkpoint_every:
+                return os.path.join(
+                    self.cfg.learner.checkpoint_dir, "timeline"
                 )
             return None
         return d

@@ -202,6 +202,13 @@ RSVC_ACK_MAGIC = b"APXA"
 # the membership table.
 FLEET_MAGIC = b"APXF"
 FLEET_ACK_MAGIC = b"APXG"
+# Fleet timeline record magic (obs/timeline.py): every record of the
+# on-disk flight-data recorder leads with this header magic on the
+# chunk framing discipline (magic | version | flags | payload_len |
+# crc32).  Registered HERE — not in obs/ — so the wire registry owns
+# every 4-byte magic in one module and a collision with a future
+# protocol is a lint finding, not a decode ambiguity.
+TIMELINE_MAGIC = b"APXL"
 # magic, version, member_id (stable per member name), incarnation, token
 FLEET_HELLO = struct.Struct("<4sIqqq")
 FLEET_HELLO_VERSION = 1

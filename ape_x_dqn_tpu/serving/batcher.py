@@ -119,6 +119,11 @@ class MicroBatcher:
         self.shed_count = 0
         self.error_count = 0
         self.batch_hist: dict[int, int] = {}   # real batch size -> count
+        # Per-param_version latency split (newest few versions): the
+        # canary sensor — version atomicity per batch means one lookup
+        # covers the whole batch.
+        self.by_version: dict[int, dict] = {}
+        self.max_versions = 4
         self._started = False
         # Liveness for /healthz (obs.Health age fn): the worker loop
         # stamps this every iteration — including idle ones — so a stale
@@ -225,9 +230,18 @@ class MicroBatcher:
         done = time.monotonic()
         self.batch_hist[n] = self.batch_hist.get(n, 0) + 1
         self.served.add(n)
+        vrow = self.by_version.get(int(version))
+        if vrow is None:
+            vrow = self.by_version[int(version)] = {
+                "replies": 0, "hist": LatencyHistogram()
+            }
+            while len(self.by_version) > self.max_versions:
+                del self.by_version[min(self.by_version)]
+        vrow["replies"] += n
         for i, r in enumerate(batch):
             latency = done - r.t_enqueue
             self.latency.record(latency)
+            vrow["hist"].record(latency)
             r.future.set_result(
                 ServedAction(
                     int(actions[i]),

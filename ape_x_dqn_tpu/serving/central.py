@@ -46,7 +46,7 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
-from ape_x_dqn_tpu.obs.lineage import TraceSpanLog
+from ape_x_dqn_tpu.obs.lineage import BucketExemplars, TraceSpanLog
 from ape_x_dqn_tpu.runtime.net import (
     CODEC_OFF,
     CODEC_ZLIB,
@@ -123,6 +123,9 @@ class CentralInferenceClient:
         self._ever_connected = False
         # Counters (the worker half of the obs `inference` section).
         self.rtt = LatencyHistogram()
+        # Newest trace id per rtt bucket: an rtt p99 spike on the fleet
+        # rollup links to an assembled cross-tier timeline.
+        self.rtt_exemplars = BucketExemplars(self.rtt)
         self.requests = 0        # group requests sent (incl. resends)
         self.rows = 0            # observation rows shipped
         self.replies = 0         # verified F_IREP replies adopted
@@ -311,7 +314,9 @@ class CentralInferenceClient:
                 version = ver if version is None else min(version, ver)
                 self.replies += 1
                 self._backoff.reset()
-                self.rtt.record(time.monotonic() - t_send[rid])
+                rtt_s = time.monotonic() - t_send[rid]
+                self.rtt.record(rtt_s)
+                self.rtt_exemplars.record(rtt_s, trace_id)
                 self.spans.record(trace_id, "inf.select.client",
                                   t_send[rid], rows=hi - lo, wid=self.wid)
                 continue
@@ -360,6 +365,7 @@ class CentralInferenceClient:
             "dedup_ref_bytes": self.dedup_ref_bytes,
             "compressed_frames": self.compressed_frames,
             "rtt": self.rtt.summary(),
+            "rtt_exemplars": self.rtt_exemplars.snapshot(),
         }
         if include_hist:
             with self.rtt._lock:
@@ -391,6 +397,7 @@ def aggregate_inference_stats(stats_dicts, mode: str = "central") -> dict:
     version = -1
     wire = logical = 0
     hist = LatencyHistogram()
+    exemplars: dict = {}
     for st in dicts:
         for k in agg:
             agg[k] += int(st.get(k, 0))
@@ -402,7 +409,11 @@ def aggregate_inference_stats(stats_dicts, mode: str = "central") -> dict:
         rs = st.get("rtt_state")
         if rs:
             merge_rtt_state(hist, rs)
+        ex = st.get("rtt_exemplars")
+        if isinstance(ex, dict):
+            exemplars.update(ex)
     agg.update(
+        rtt_exemplars=exemplars,
         mode=mode,
         workers_reporting=len(dicts),
         stall_ms=round(stall, 1),

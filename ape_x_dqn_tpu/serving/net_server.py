@@ -45,7 +45,7 @@ import threading
 import time
 from typing import Optional
 
-from ape_x_dqn_tpu.obs.lineage import TraceSpanLog
+from ape_x_dqn_tpu.obs.lineage import BucketExemplars, TraceSpanLog
 from ape_x_dqn_tpu.runtime.net import (
     CODEC_OFF,
     E_BAD_REQUEST,
@@ -90,6 +90,7 @@ from ape_x_dqn_tpu.utils.metrics import LatencyHistogram
 
 _RECV_CHUNK = 1 << 16
 _HELLO_SIZE = len(serve_hello_bytes())
+_MAX_VERSIONS = 4   # per-version latency splits kept (newest versions)
 
 
 class _NetConn:
@@ -154,6 +155,14 @@ class ServingNetServer:
         self._started = False
         # Counters (the serving_net schema).
         self.latency = LatencyHistogram()
+        # Trace exemplars: the newest sampled trace id per latency
+        # bucket, so a p99 spike on the fleet rollup links to an
+        # assembled cross-tier timeline instead of a bare number.
+        self.exemplars = BucketExemplars(self.latency)
+        # Per-param_version split of the reply latency (the canary
+        # sensor): newest _MAX_VERSIONS versions only — a long run
+        # reloads thousands of times, the comparison needs two or three.
+        self._by_version: dict = {}   # version -> {replies, hist}
         self.accepted = 0
         self.requests = 0
         self.replies = 0
@@ -416,8 +425,26 @@ class ServingNetServer:
             return
         if exc is None:
             self.replies += 1
-            self.latency.record(time.monotonic() - t0)
+            self._record_reply(res.param_version,
+                               time.monotonic() - t0, trace_id)
             self.spans.record(trace_id, "serve.request", t0, wid=conn.wid)
+
+    def _record_reply(self, version: int, dt: float, trace_id: int) -> None:
+        """One reply's latency, recorded three ways: the lifetime
+        histogram, its bucket exemplar (the trace id that landed there),
+        and the per-param_version split the canary comparison reads."""
+        self.latency.record(dt)
+        self.exemplars.record(dt, trace_id)
+        with self._lock:
+            row = self._by_version.get(int(version))
+            if row is None:
+                row = self._by_version[int(version)] = {
+                    "replies": 0, "hist": LatencyHistogram()
+                }
+                while len(self._by_version) > _MAX_VERSIONS:
+                    del self._by_version[min(self._by_version)]
+            row["replies"] += 1
+            row["hist"].record(dt)
 
     # -- batched fleet inference (F_IREQ/F_IREP) ---------------------------
 
@@ -528,11 +555,11 @@ class ServingNetServer:
         self.replies += 1
         self.inference_replies += 1
         self._source_count(conn.wid, replies=1)
-        self.latency.record(time.monotonic() - t0)
+        tid = agg["trace_id"]
+        self._record_reply(version, time.monotonic() - t0, tid)
         # Two hops of the e2e inference timeline: the replica's whole
         # service span (decode → reply queued) and the batcher leg inside
         # it (rows submitted → last row's future landed).
-        tid = agg["trace_id"]
         self.spans.record(tid, "serve.infer", t0, wid=conn.wid,
                           rows=len(results))
         self.spans.record(tid, "serve.batch", agg["t_submit"], wid=conn.wid)
@@ -578,6 +605,12 @@ class ServingNetServer:
         with self._lock:
             conns = list(self._conns.values())
             sources = {k: dict(v) for k, v in self._sources.items()}
+            by_version = {
+                str(v): {"replies": row["replies"],
+                         "latency": row["hist"].summary(),
+                         "latency_buckets": row["hist"].buckets()}
+                for v, row in sorted(self._by_version.items())
+            }
         return {
             "port": self.port,
             "connections": len(conns),
@@ -605,6 +638,8 @@ class ServingNetServer:
             # aggregator can merge replicas bucket-wise, and this
             # process's recent cross-tier trace spans.
             "latency_buckets": self.latency.buckets(),
+            "latency_exemplars": self.exemplars.snapshot(),
+            "by_version": by_version,
             "recent_spans": self.spans.snapshot(),
         }
 

@@ -244,6 +244,13 @@ class PolicyServer:
             "reloads": self.reload_count,
             "batch_hist": {str(k): v for k, v in sorted(b.batch_hist.items())},
             "latency": b.latency.summary(),
+            # Canary sensor: latency split by the param_version each
+            # batch served under (newest few versions, see MicroBatcher).
+            "by_version": {
+                str(v): {"replies": row["replies"],
+                         "latency": row["hist"].summary()}
+                for v, row in sorted(b.by_version.items())
+            },
         }
         # Versions behind the source (publishes missed): staleness as the
         # param store defines it, from the serving side.

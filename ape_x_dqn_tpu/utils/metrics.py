@@ -236,6 +236,22 @@ class LatencyHistogram:
             out[f"{edge:.6g}"] = c
         return out
 
+    def bucket_edge(self, seconds: float) -> str:
+        """The ``buckets()`` label the given value records into —
+        how a bucket exemplar (a sampled trace id) gets keyed to the
+        SAME bucket its count landed in, without duplicating the index
+        arithmetic at every record site."""
+        s = float(seconds)
+        if s < self._min:
+            return f"{self._min:.6g}"
+        i = min(
+            1 + int(math.log10(s / self._min) * self._per),
+            len(self._counts) - 1,
+        )
+        if i == len(self._counts) - 1:
+            return "+Inf"
+        return f"{self._min * 10 ** (i / self._per):.6g}"
+
     def summary(self) -> dict:
         """{count, mean_ms, p50_ms, p95_ms, p99_ms, max_ms} snapshot."""
         with self._lock:

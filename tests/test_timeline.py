@@ -1,0 +1,502 @@
+"""Flight-data recorder (ISSUE 19): the on-disk timeline store's
+framing/commit/adopt discipline, delta compaction that stays
+bit-consistent with the live ``_BucketWindow`` rollup, the SLO
+burn-window rebuild that kills the post-respawn blind window, the
+concurrent scrape plane's cadence under a hung endpoint, per-version
+serving telemetry, Prometheus exposition correctness, and the
+``obs_diff`` run-vs-run regression report."""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+
+import pytest
+
+from ape_x_dqn_tpu.obs.fleet import (
+    FleetAggregator,
+    SloEngine,
+    SloRule,
+    _BucketWindow,
+    _endpoints_down,
+)
+from ape_x_dqn_tpu.obs.timeline import (
+    TimelineStore,
+    read_segment,
+    read_timeline,
+)
+from ape_x_dqn_tpu.runtime.net import TIMELINE_MAGIC
+from ape_x_dqn_tpu.utils.metrics import bucket_percentile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _doc_keys(section_header):
+    from ape_x_dqn_tpu.analysis.metrics_doc import doc_section_keys
+
+    return doc_section_keys(
+        section_header, os.path.join(REPO, "docs", "METRICS.md"))
+
+
+def _rollup(i, *, buckets=None, alive=5, down=0):
+    """A minimal fleet rollup for sweep ``i`` with cumulative counters."""
+    buckets = buckets if buckets is not None else {
+        "0.001": 3 * (i + 1), "0.01": i + 1}
+    return {
+        "alive": alive, "expected": alive + down,
+        "endpoints": {
+            f"ep{j}": {"alive": j >= down} for j in range(alive + down)
+        },
+        "scrapes": 5 * (i + 1), "scrape_failures": down * (i + 1),
+        "serving": {"replicas": 2, "count": sum(buckets.values()),
+                    "qps": 10.0, "latency_buckets": dict(buckets),
+                    "window": {"count": 1, "p99_ms": 1.0},
+                    "exemplars": {"0.001": 1000 + i}},
+        "replay": {"shards_alive": 2, "total_added": 11 * (i + 1),
+                   "add_qps": 11.0, "occupancy": 0.25,
+                   "op_buckets": {"0.001": 11 * (i + 1)},
+                   "op_exemplars": {"0.001": 2000 + i}},
+        "age_of_experience": {"count": 4 * (i + 1),
+                              "buckets_s": {"0.1": 4 * (i + 1)},
+                              "window": {"count": 4, "p95_s": 0.1}},
+        "inference": {"rtt_exemplars": {"0.01": 3000 + i}},
+        "ring_occupancy_max": 0.5,
+    }
+
+
+class TestTimelineStore:
+    def test_append_compacts_deltas_and_roundtrips(self, tmp_path):
+        st = TimelineStore(str(tmp_path))
+        for i in range(3):
+            st.append_sweep(_rollup(i), now=100.0 + i)
+        st.close()
+        doc = read_timeline(str(tmp_path))
+        assert doc["torn"] == 0 and len(doc["records"]) == 3
+        r0, r1, _ = doc["records"]
+        # First sweep's delta is the full cumulative; later sweeps store
+        # only the per-sweep increment.
+        assert r0["counters"]["replay_added"] == 11
+        assert r1["counters"]["replay_added"] == 11
+        assert r0["hist"]["serving_s"] == {"0.001": 3, "0.01": 1}
+        assert r1["hist"]["serving_s"] == {"0.001": 3, "0.01": 1}
+        assert r1["gauges"]["alive"] == 5
+        assert r1["exemplars"]["replay_op"] == {"0.001": 2001}
+
+    def test_records_carry_registered_magic(self, tmp_path):
+        st = TimelineStore(str(tmp_path), compress=False)
+        st.append_sweep(_rollup(0), now=1.0)
+        st.close()
+        seg = sorted(p for p in os.listdir(tmp_path)
+                     if p.endswith(".seg"))[0]
+        with open(tmp_path / seg, "rb") as f:
+            assert f.read(4) == TIMELINE_MAGIC
+
+    def test_torn_tail_dropped_at_frame_boundary(self, tmp_path):
+        st = TimelineStore(str(tmp_path), compress=False)
+        for i in range(4):
+            st.append_sweep(_rollup(i), now=10.0 + i)
+        path = st._active_path()
+        st.close()
+        # A SIGKILL mid-write leaves a half-frame: truncate the (now
+        # committed) segment mid-record and re-read.
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - 7)
+        recs, torn = read_segment(path)
+        assert len(recs) == 3 and torn == 1
+        # Corruption inside a payload (CRC mismatch) also stops the read
+        # at the last good frame instead of decoding garbage.
+        with open(path, "r+b") as f:
+            f.seek(20)
+            f.write(b"\xff")
+        recs2, torn2 = read_segment(path)
+        assert len(recs2) < 3 and torn2 >= 1
+
+    def test_unclean_shutdown_tail_is_adopted(self, tmp_path):
+        st = TimelineStore(str(tmp_path))
+        for i in range(5):
+            st.append_sweep(_rollup(i), now=50.0 + i)
+        # NO close(): the active segment is an uncommitted orphan.
+        del st
+        st2 = TimelineStore(str(tmp_path))
+        assert st2.adopted_records == 5
+        assert len(st2.records()) == 5
+        # Delta marks resume from the adopted tail's cumulative echo —
+        # the next sweep must NOT double-count the whole run.
+        st2.append_sweep(_rollup(5), now=55.0)
+        last = st2.records()[-1]
+        assert last["counters"]["replay_added"] == 11
+        assert last["hist"]["serving_s"] == {"0.001": 3, "0.01": 1}
+        st2.close()
+
+    def test_rotation_and_generation_pruning_bound_disk(self, tmp_path):
+        st = TimelineStore(str(tmp_path), max_bytes=8192,
+                           segment_bytes=2048, compress=False)
+        for i in range(200):
+            st.append_sweep(_rollup(i), now=1000.0 + i)
+        assert st.rotations > 0 and st.prunes > 0
+        total = sum(
+            os.path.getsize(tmp_path / p) for p in os.listdir(tmp_path)
+            if p.endswith(".seg"))
+        # Bounded: committed segments respect max_bytes; the active
+        # segment can overshoot by at most one segment's worth.
+        assert total <= 8192 + 2048
+        # Oldest generations are gone, newest survive, in order.
+        doc = read_timeline(str(tmp_path))
+        ts = [r["t"] for r in doc["records"]]
+        assert ts == sorted(ts) and ts[0] > 1000.0
+        st.close()
+
+    def test_windowed_percentile_bit_consistent_with_live_window(
+            self, tmp_path):
+        st = TimelineStore(str(tmp_path))
+        win = _BucketWindow(window_s=60.0)
+        cum = {}
+        for i in range(30):
+            # A drifting cumulative distribution.
+            cum = {"0.001": 5 * (i + 1), "0.01": 2 * (i + 1),
+                   "0.1": i // 3}
+            now = 500.0 + i * 0.3
+            win.feed(cum, now)
+            st.append_sweep(_rollup(i, buckets=cum), now=now)
+        t1 = 500.0 + 29 * 0.3
+        for q in (50, 90, 99):
+            assert st.percentile("serving_s", q, t1 - 60.0, t1) \
+                == win.percentile(q)
+        # And an arbitrary sub-window re-aggregates consistently.
+        mid = st.merged_buckets("serving_s", 502.0, 505.0)
+        assert st.percentile("serving_s", 99, 502.0, 505.0) \
+            == bucket_percentile(mid, 99)
+        st.close()
+
+    def test_rate_windows(self, tmp_path):
+        st = TimelineStore(str(tmp_path))
+        for i in range(10):
+            st.append_sweep(_rollup(i), now=100.0 + i)
+        # 11 adds/sweep, 1s apart: records at t in [104, 109] carry
+        # 6 deltas of 11 over a 5s window.
+        assert st.rate("replay_added", 5.0, now=109.0) \
+            == pytest.approx(66 / 5.0)
+        # A key the fleet never reported rates 0 (covered but silent);
+        # a window past the stored span has no coverage at all.
+        assert st.rate("nonexistent", 5.0, now=109.0) == 0.0
+        assert st.rate("replay_added", 5.0, now=200.0) is None
+        st.close()
+        empty = TimelineStore(str(tmp_path / "empty"))
+        assert empty.rate("replay_added", 5.0) is None
+        empty.close()
+
+    def test_exemplar_lookup_newest_and_by_bucket(self, tmp_path):
+        st = TimelineStore(str(tmp_path))
+        for i in range(4):
+            st.append_sweep(_rollup(i), now=10.0 + i)
+        assert st.exemplar("replay_op") == 2003
+        assert st.exemplar("replay_op", edge="0.001") == 2003
+        assert st.exemplar("serving", edge="0.001") == 1003
+        assert st.exemplar("serving", edge="99") is None
+        st.close()
+
+    def test_stats_match_doc(self, tmp_path):
+        st = TimelineStore(str(tmp_path))
+        st.append_sweep(_rollup(0), now=1.0)
+        assert set(st.stats()) == set(_doc_keys("## Timeline schema"))
+        st.close()
+
+    def test_bad_geometry_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            TimelineStore(str(tmp_path), max_bytes=10, segment_bytes=20)
+
+
+class TestSloRebuild:
+    def _engine(self, events):
+        return SloEngine(
+            [SloRule("endpoints_alive", "upper", 0.0, _endpoints_down)],
+            window_s=8.0, burn_threshold=0.5, clear_threshold=0.1,
+            min_samples=3,
+            emit=lambda name, **f: events.append(name),
+        )
+
+    def test_rebuild_restores_breach_without_events(self, tmp_path):
+        st = TimelineStore(str(tmp_path))
+        ev1: list = []
+        eng1 = self._engine(ev1)
+        now = 100.0
+        for i in range(6):
+            roll = _rollup(i, alive=4, down=1)
+            status = eng1.evaluate(roll, now=now)
+            st.append_sweep(roll, status, now=now)
+            now += 0.5
+        assert eng1.rules[0].state == "breach" and ev1 == ["slo_breach"]
+        del st      # SIGKILL-equivalent: no close
+
+        ev2: list = []
+        eng2 = self._engine(ev2)
+        st2 = TimelineStore(str(tmp_path))
+        filled = st2.rebuild_slo(eng2, now=now)
+        # The cold engine comes back already in breach, window refilled,
+        # with NO breach/clear emitted during the rebuild itself.
+        assert filled == 1 and ev2 == []
+        rule = eng2.rules[0]
+        assert rule.state == "breach" and len(rule._window) == 6
+        assert st2.rebuilds == 1
+        # The recovery clear then fires off the restored window — once
+        # the old violated samples age out, not min_samples later.
+        for _ in range(20):
+            eng2.evaluate(_rollup(0, alive=5), now=now)
+            now += 0.5
+        assert ev2 == ["slo_clear"] and rule.state == "ok"
+        st2.close()
+
+    def test_rebuild_on_empty_timeline_is_noop(self, tmp_path):
+        st = TimelineStore(str(tmp_path))
+        ev: list = []
+        eng = self._engine(ev)
+        assert st.rebuild_slo(eng) == 0 and ev == []
+        assert eng.rules[0].state == "ok"
+        st.close()
+
+
+class TestConcurrentScrape:
+    def test_hung_endpoint_does_not_stretch_the_sweep(self):
+        """The serial loop cost N x timeout per sweep once one endpoint
+        wedged; the concurrent plane bounds the WHOLE cycle near one
+        timeout, keeps scraping the healthy members, and refuses to
+        stack workers behind the stuck one."""
+        hang = threading.Event()
+        calls = {"healthy": 0}
+
+        def wedged():
+            hang.wait(20.0)
+            return {}
+
+        def healthy():
+            calls["healthy"] += 1
+            return {"replay_service": {"requests": 1}}
+
+        agg = FleetAggregator(scrape_timeout_s=0.5, scrape_workers=4)
+        try:
+            agg.add_local("stuck", wedged, kind="trainer")
+            for i in range(3):
+                agg.add_local(f"ok{i}", healthy, kind="trainer")
+            t0 = time.monotonic()
+            agg.scrape_once()
+            first = time.monotonic() - t0
+            assert first < 2.0          # one deadline, not 4 timeouts
+            roll = agg.rollup()
+            assert roll["alive"] == 3
+            assert "ScrapeDeadline" in \
+                roll["endpoints"]["stuck"]["last_error"]
+            # Second sweep: the wedged future is still in flight — the
+            # endpoint reports stuck instead of queueing another worker.
+            t0 = time.monotonic()
+            agg.scrape_once()
+            assert time.monotonic() - t0 < 2.0
+            assert "ScrapeStuck" in \
+                agg.rollup()["endpoints"]["stuck"]["last_error"]
+            assert calls["healthy"] == 6   # healthy members kept cadence
+        finally:
+            hang.set()
+            agg.close()
+
+    def test_attach_timeline_records_sweeps_and_lifts_windows(
+            self, tmp_path):
+        agg = FleetAggregator(scrape_timeout_s=1.0, window_s=30.0)
+        try:
+            agg.add_local(
+                "shard0",
+                lambda: {"requests": 5, "total_added": 7, "size": 7,
+                         "capacity": 100},
+                kind="shard")
+            st = TimelineStore(str(tmp_path))
+            agg.attach_timeline(st)
+            agg.scrape_once()
+            time.sleep(0.05)
+            agg.scrape_once()
+            recs = st.records()
+            assert len(recs) == 2
+            assert recs[0]["gauges"]["alive"] == 1
+            # The windowed replay add rate is lifted back INTO the
+            # rollup for the autopilot's idle rules.
+            rep = agg.rollup()["replay"]
+            assert rep["window"]["add_qps"] >= 0.0
+        finally:
+            agg.close()
+
+
+class TestPerVersionServing:
+    def test_net_server_splits_stats_by_param_version(self):
+        from ape_x_dqn_tpu.serving.net_server import ServingNetServer
+
+        class _Stub:
+            def infer(self, obs):
+                raise NotImplementedError
+
+        srv = ServingNetServer(_Stub())
+        for v, dt in ((3, 0.001), (3, 0.002), (4, 0.1)):
+            srv._record_reply(v, dt, trace_id=v * 10)
+        stats = srv.stats()
+        assert stats["by_version"]["3"]["replies"] == 2
+        assert stats["by_version"]["4"]["replies"] == 1
+        assert stats["by_version"]["4"]["latency"]["p50_ms"] \
+            > stats["by_version"]["3"]["latency"]["p50_ms"]
+        assert stats["by_version"]["3"]["latency_buckets"]
+        # Exemplars: the newest trace id lands in the bucket its
+        # latency resolves to.
+        assert 40 in stats["latency_exemplars"].values()
+
+    def test_version_rows_are_bounded(self):
+        from ape_x_dqn_tpu.serving.net_server import ServingNetServer
+        from ape_x_dqn_tpu.serving.net_server import _MAX_VERSIONS
+
+        srv = ServingNetServer(object())
+        for v in range(10):
+            srv._record_reply(v, 0.001, trace_id=0)
+        stats = srv.stats()
+        assert len(stats["by_version"]) == _MAX_VERSIONS
+        # Oldest versions evicted, newest kept.
+        assert set(stats["by_version"]) == {"6", "7", "8", "9"}
+
+
+class TestPrometheusExposition:
+    def test_nan_and_inf_spellings(self):
+        from ape_x_dqn_tpu.obs.registry import MetricsRegistry
+
+        r = MetricsRegistry(prefix="apex")
+        r.gauge("nan_g").set(float("nan"))
+        r.gauge("inf_g").set(float("inf"))
+        r.gauge("ninf_g").set(float("-inf"))
+        text = r.prometheus_text()
+        # The exposition format's exact spellings — not python's
+        # str(float) forms ("nan"/"inf"), which scrapers reject.
+        assert "apex_nan_g NaN" in text
+        assert "apex_inf_g +Inf" in text
+        assert "apex_ninf_g -Inf" in text
+        assert "apex_nan_g nan" not in text
+
+    def test_help_text_is_escaped(self):
+        from ape_x_dqn_tpu.obs.registry import MetricsRegistry
+
+        r = MetricsRegistry(prefix="apex")
+        r.counter("c", help="line one\nline two \\ backslash").inc()
+        text = r.prometheus_text()
+        help_line = next(ln for ln in text.splitlines()
+                         if ln.startswith("# HELP apex_c"))
+        assert "\n" not in help_line
+        assert "line one\\nline two \\\\ backslash" in help_line
+
+    def test_summary_emits_sum_and_ordered_quantiles(self):
+        from ape_x_dqn_tpu.obs.registry import MetricsRegistry
+
+        r = MetricsRegistry(prefix="apex")
+        h = r.histogram("lat")
+        for dt in (0.001, 0.002, 0.005, 0.05):
+            h.observe(dt)
+        lines = r.prometheus_text().splitlines()
+        qs = [float(ln.split()[-1]) for ln in lines
+              if 'apex_lat{quantile=' in ln]
+        assert qs == sorted(qs) and len(qs) == 3
+        sum_line = next(ln for ln in lines
+                        if ln.startswith("apex_lat_sum "))
+        assert float(sum_line.split()[-1]) == pytest.approx(0.058)
+        # _sum precedes _count (scrapers pair them within one family).
+        assert lines.index(sum_line) < lines.index(
+            next(ln for ln in lines if ln.startswith("apex_lat_count")))
+
+    def test_provider_nan_leaf_is_spelled_not_crashed(self):
+        from ape_x_dqn_tpu.obs.registry import MetricsRegistry
+
+        r = MetricsRegistry(prefix="apex")
+        r.register_provider("p", lambda: {"bad": float("nan"),
+                                          "good": 1.0})
+        text = r.prometheus_text()
+        assert "apex_p_good 1" in text and "apex_p_bad NaN" in text
+
+    def test_metrics_endpoint_serves_the_exposition(self):
+        import urllib.request
+
+        from ape_x_dqn_tpu.obs.exporter import ObsServer
+        from ape_x_dqn_tpu.obs.registry import MetricsRegistry
+
+        r = MetricsRegistry(prefix="apex")
+        r.gauge("spiky").set(float("inf"))
+        r.histogram("lat").observe(0.003)
+        srv = ObsServer(r, port=0)
+        try:
+            with urllib.request.urlopen(
+                    f"{srv.url}/metrics", timeout=5.0) as resp:
+                assert resp.headers["Content-Type"].startswith(
+                    "text/plain")
+                text = resp.read().decode()
+            assert "apex_spiky +Inf" in text
+            assert "apex_lat_sum" in text and "apex_lat_count 1" in text
+            assert text.endswith("\n")
+        finally:
+            srv.close()
+
+
+class TestObsDiff:
+    def _mk(self, tmp_path, name, n=10, lat_edge="0.001"):
+        st = TimelineStore(str(tmp_path / name))
+        for i in range(n):
+            st.append_sweep(
+                _rollup(i, buckets={lat_edge: 5 * (i + 1)}),
+                {"rules": {"r": {"state": "ok", "kind": "upper",
+                                 "bound": 0.0, "value": 0.0,
+                                 "burn": 0.0, "samples": 5,
+                                 "breaches": 0, "clears": 0}}},
+                now=100.0 + i)
+        st.close()
+        return str(tmp_path / name)
+
+    def test_diff_flags_latency_regression_only(self, tmp_path):
+        sys_path_hack = REPO
+        import sys
+        if sys_path_hack not in sys.path:
+            sys.path.insert(0, sys_path_hack)
+        from tools import obs_diff
+
+        a = self._mk(tmp_path, "a", lat_edge="0.001")
+        b = self._mk(tmp_path, "b", lat_edge="0.1")
+        report = obs_diff.diff(obs_diff.load_side(a),
+                               obs_diff.load_side(b))
+        assert not report["ok"]
+        assert "serving_p99_ms" in report["regressions"]
+        # Same run against itself: clean.
+        self_report = obs_diff.diff(obs_diff.load_side(a),
+                                    obs_diff.load_side(a))
+        assert self_report["ok"] and not self_report["regressions"]
+        assert "serving_p99_ms" in [r["metric"]
+                                    for r in self_report["rows"]]
+
+    def test_load_side_accepts_demo_artifact_wrapper(self, tmp_path):
+        import sys
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from tools import obs_diff
+
+        a = self._mk(tmp_path, "a")
+        summary = obs_diff.load_side(a)
+        demo = tmp_path / "demo.json"
+        demo.write_text(json.dumps({"ok": True,
+                                    "timeline_summary": summary}))
+        assert obs_diff.load_side(str(demo)) == summary
+        with pytest.raises(ValueError):
+            bad = tmp_path / "bad.json"
+            bad.write_text(json.dumps({"unrelated": 1}))
+            obs_diff.load_side(str(bad))
+
+    def test_render_is_line_oriented(self, tmp_path):
+        import sys
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from tools import obs_diff
+
+        a = self._mk(tmp_path, "a")
+        report = obs_diff.diff(obs_diff.load_side(a),
+                               obs_diff.load_side(a))
+        out = obs_diff.render(report)
+        assert out.splitlines()[0].startswith("== obs_diff ==")
+        assert "OK" in out.splitlines()[0]

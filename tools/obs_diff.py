@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""obs_diff — diff two runs' fleet timelines into a regression report.
+
+The missing consumer for the BENCH/demos trajectory: every run that
+carries a flight-data recorder (``obs.timeline_dir``, obs/timeline.py)
+leaves a durable fleet time-series behind, and this tool answers "did
+this change make the fleet worse" by comparing two of them — latency
+percentiles re-derived from the stored bucket deltas, counter rates,
+gauge envelopes, SLO burn fractions, torn-record counts.
+
+Each side is either
+
+  * a timeline DIRECTORY (read via ``obs.timeline.read_timeline``), or
+  * a JSON file — a summary this tool wrote (``summarize`` shape), or a
+    committed demo artifact that embeds one under ``timeline_summary``
+    (how ``tools/fleet_obs_smoke.py`` self-checks against the previous
+    committed ``demos/timeline.json``).
+
+Regressions (latency/burn/torn up, throughput down, beyond
+``--tolerance``) are flagged in the report; ``--fail-on-regress`` turns
+them into a nonzero exit for CI gates.
+
+    python tools/obs_diff.py RUN_A RUN_B [--out report.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# Percentiles recomputed from the stored per-sweep bucket deltas — the
+# same merge arithmetic the live rollup and the store's own queries use.
+_HIST_POINTS = (
+    ("serving_s", "serving_p50_ms", 50, 1e3),
+    ("serving_s", "serving_p99_ms", 99, 1e3),
+    ("replay_op_s", "replay_op_p95_ms", 95, 1e3),
+    ("age_s", "age_p95_s", 95, 1.0),
+)
+#: metrics where UP is worse (latency, burn, torn); DOWN is worse for
+#: the rest (throughput-like counters and gauges).
+_UP_IS_BAD = ("p50_ms", "p99_ms", "p95_ms", "p95_s", "burn", "torn")
+
+
+def load_side(path: str) -> dict:
+    """A comparable summary from either a timeline dir or a JSON file."""
+    if os.path.isdir(path):
+        from ape_x_dqn_tpu.obs.timeline import read_timeline
+
+        return summarize(read_timeline(path))
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if "timeline_summary" in doc:          # demo-artifact wrapper
+        return dict(doc["timeline_summary"])
+    if "records" in doc and isinstance(doc["records"], list):
+        return summarize(doc)              # raw read_timeline dump
+    if "gauges" in doc and "counters" in doc:
+        return dict(doc)                   # already a summary
+    raise ValueError(f"{path}: neither a timeline, a summary, nor a "
+                     "demo artifact with one")
+
+
+def summarize(doc: dict) -> dict:
+    """Compress a loaded timeline into the comparable summary shape."""
+    from ape_x_dqn_tpu.utils.metrics import (
+        bucket_percentile,
+        merge_bucket_dicts,
+    )
+
+    recs = doc.get("records") or []
+    if not recs:
+        raise ValueError("timeline has no records")
+    t0 = float(recs[0].get("t", 0.0))
+    t1 = float(recs[-1].get("t", 0.0))
+    span = max(t1 - t0, 1e-9)
+    gauges: dict = {}
+    for r in recs:
+        for k, v in (r.get("gauges") or {}).items():
+            if v is None:
+                continue
+            g = gauges.setdefault(k, {"n": 0, "sum": 0.0, "max": None})
+            g["n"] += 1
+            g["sum"] += float(v)
+            g["max"] = float(v) if g["max"] is None \
+                else max(g["max"], float(v))
+    counters: dict = {}
+    for r in recs:
+        for k, v in (r.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + int(v)
+    hists: dict = {}
+    for r in recs:
+        for k, d in (r.get("hist") or {}).items():
+            if d:
+                hists[k] = merge_bucket_dicts(hists.get(k, {}), d)
+    percentiles: dict = {}
+    for key, name, q, scale in _HIST_POINTS:
+        merged = hists.get(key) or {}
+        if any(merged.values()):
+            percentiles[name] = round(
+                bucket_percentile(merged, q) * scale, 3
+            )
+    slo: dict = {}
+    for r in recs:
+        for name, ent in (r.get("slo") or {}).items():
+            s = slo.setdefault(
+                name, {"samples": 0, "violated": 0, "breach_records": 0}
+            )
+            if ent.get("x") is not None:
+                s["samples"] += 1
+                s["violated"] += int(ent["x"])
+            if ent.get("s") == "breach":
+                s["breach_records"] += 1
+            s["final_state"] = ent.get("s", "ok")
+    for s in slo.values():
+        s["burn"] = round(s["violated"] / s["samples"], 3) \
+            if s["samples"] else 0.0
+    return {
+        "records": len(recs),
+        "span_s": round(span, 1),
+        "torn": int(doc.get("torn", 0)),
+        "gauges": {
+            k: {"mean": round(g["sum"] / g["n"], 4), "max": g["max"]}
+            for k, g in sorted(gauges.items()) if g["n"]
+        },
+        "counters": {
+            k: {"total": v, "rate_s": round(v / span, 3)}
+            for k, v in sorted(counters.items())
+        },
+        "percentiles": percentiles,
+        "slo": slo,
+    }
+
+
+def _rows(side: dict, prefix: str = "") -> dict:
+    """Flatten a summary into comparable scalar rows."""
+    out: dict = {"torn": side.get("torn", 0)}
+    for k, g in (side.get("gauges") or {}).items():
+        out[f"gauge.{k}.mean"] = g.get("mean")
+    for k, c in (side.get("counters") or {}).items():
+        out[f"rate.{k}_s"] = c.get("rate_s")
+    for k, v in (side.get("percentiles") or {}).items():
+        out[k] = v
+    for name, s in (side.get("slo") or {}).items():
+        out[f"slo.{name}.burn"] = s.get("burn")
+    return out
+
+
+def diff(a: dict, b: dict, tolerance: float = 0.1) -> dict:
+    """Row-by-row comparison: ``b`` (candidate) vs ``a`` (baseline).
+    A row regresses when it moves in its bad direction by more than
+    ``tolerance`` (relative, with a small absolute floor so a 0→0.001
+    blip is not a 'regression')."""
+    ra, rb = _rows(a), _rows(b)
+    rows = []
+    regressions = []
+    for key in sorted(set(ra) | set(rb)):
+        va, vb = ra.get(key), rb.get(key)
+        row = {"metric": key, "baseline": va, "candidate": vb}
+        if isinstance(va, (int, float)) and isinstance(vb, (int, float)):
+            delta = vb - va
+            row["delta"] = round(delta, 4)
+            base = max(abs(va), 1e-9)
+            rel = delta / base
+            row["delta_rel"] = round(rel, 4)
+            up_is_bad = any(key.endswith(sfx) or sfx in key
+                            for sfx in _UP_IS_BAD)
+            worse = rel > tolerance if up_is_bad else rel < -tolerance
+            if worse and abs(delta) > 1e-6:
+                row["regression"] = True
+                regressions.append(key)
+        rows.append(row)
+    return {
+        "baseline": {"records": a.get("records"),
+                     "span_s": a.get("span_s")},
+        "candidate": {"records": b.get("records"),
+                      "span_s": b.get("span_s")},
+        "tolerance": tolerance,
+        "rows": rows,
+        "regressions": regressions,
+        "ok": not regressions,
+    }
+
+
+def render(report: dict) -> str:
+    lines = [
+        "== obs_diff ==  "
+        f"baseline {report['baseline']['records']} recs "
+        f"/ {report['baseline']['span_s']}s   "
+        f"candidate {report['candidate']['records']} recs "
+        f"/ {report['candidate']['span_s']}s   "
+        + ("OK" if report["ok"]
+           else f"REGRESS[{','.join(report['regressions'])}]")
+    ]
+    for row in report["rows"]:
+        va, vb = row["baseline"], row["candidate"]
+        mark = " <-- REGRESSION" if row.get("regression") else ""
+        rel = row.get("delta_rel")
+        lines.append(
+            f" {row['metric']:<28} {va!s:>12} -> {vb!s:>12}"
+            + (f"  ({rel:+.1%})" if rel is not None else "")
+            + mark
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="obs_diff")
+    ap.add_argument("baseline",
+                    help="timeline dir, summary JSON, or demo artifact")
+    ap.add_argument("candidate",
+                    help="timeline dir, summary JSON, or demo artifact")
+    ap.add_argument("--tolerance", type=float, default=0.1,
+                    help="relative movement (in the bad direction) "
+                    "flagged as a regression (default 0.1 = 10%%)")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="also write the JSON report here")
+    ap.add_argument("--fail-on-regress", action="store_true",
+                    help="exit 1 when any row regressed")
+    args = ap.parse_args(argv)
+    report = diff(load_side(args.baseline), load_side(args.candidate),
+                  tolerance=args.tolerance)
+    print(render(report))
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1)
+    return 1 if (args.fail_on_regress and not report["ok"]) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -11,6 +11,11 @@ Two data sources, one renderer:
     (obs/fleet.py) and render the whole fleet: per-shard / per-replica /
     per-host rows (alive, p95s, occupancy), merged histograms, SLO rule
     states, and recent cross-tier trace timelines.
+  * ``--timeline DIR`` — read a run's flight-data recorder
+    (obs/timeline.py, the per-run on-disk snapshot ring) and render its
+    gauge series as sparklines, windowed counter rates, per-rule SLO
+    burn history, and the newest bucket exemplars — "what happened at
+    minute 43", offline, after the run is gone.
 
 Shows the fleet in one screen: learner throughput, per-worker actor
 stats (env-steps/s, ε slice, ring backlog, heartbeat age — the shm
@@ -75,6 +80,137 @@ def snapshot_from_jsonl(path: str) -> dict:
             out[section] = last[section]
     out["t"] = last.get("t")
     return out
+
+
+def snapshot_from_timeline(dir_path: str) -> dict:
+    """Whole-timeline load (obs/timeline.py is import-light; the lazy
+    import keeps obs_top's other modes runnable from a bare checkout of
+    just this file)."""
+    import os
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in _sys.path:
+        _sys.path.insert(0, repo)
+    from ape_x_dqn_tpu.obs.timeline import read_timeline
+
+    doc = read_timeline(dir_path)
+    if not doc["records"]:
+        raise ValueError(f"no timeline records under {dir_path}")
+    return doc
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+# Gauge series render order + formats for the timeline view.
+_TL_GAUGES = (
+    ("serving_qps", "serving qps", "{:.1f}"),
+    ("serving_p99_ms", "serving p99 ms", "{:.2f}"),
+    ("replay_add_qps", "replay add qps", "{:.1f}"),
+    ("age_p95_s", "age p95 s", "{:.2f}"),
+    ("replay_occupancy", "replay occupancy", "{:.3f}"),
+    ("ring_occupancy_max", "ring occupancy", "{:.3f}"),
+    ("alive", "endpoints alive", "{:.0f}"),
+)
+
+
+def _sparkline(values, width: int = 48) -> str:
+    """Downsample a series to ``width`` columns (mean per column) and
+    render each as one of 8 block heights, scaled min..max."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        step = len(vals) / width
+        vals = [
+            sum(vals[int(i * step):max(int(i * step) + 1,
+                                       int((i + 1) * step))])
+            / max(1, int((i + 1) * step) - int(i * step))
+            for i in range(width)
+        ]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / span * len(_SPARK)))]
+        for v in vals
+    )
+
+
+def render_timeline(doc: dict) -> str:
+    """One frame over a loaded timeline: per-gauge sparklines with
+    min/max/last, windowed counter totals, SLO burn history per rule,
+    and the newest exemplar trace ids."""
+    recs = doc.get("records") or []
+    if not recs:
+        return "(empty timeline)"
+    t0 = float(recs[0].get("t", 0.0))
+    t1 = float(recs[-1].get("t", 0.0))
+    span = max(t1 - t0, 0.0)
+    lines = [
+        "== apex-tpu timeline ==  "
+        f"{len(recs)} records over {span:.0f}s  "
+        f"{doc.get('segments', 0)} segments  "
+        f"torn {doc.get('torn', 0)}"
+    ]
+    for key, label, fmt in _TL_GAUGES:
+        series = [r["gauges"][key] for r in recs
+                  if (r.get("gauges") or {}).get(key) is not None]
+        if not series:
+            continue
+        lines.append(
+            f" {label:<18} {_sparkline(series)}  "
+            f"min {_num(min(series), fmt)} "
+            f"max {_num(max(series), fmt)} "
+            f"last {_num(series[-1], fmt)}"
+        )
+    totals: dict = {}
+    for r in recs:
+        for k, v in (r.get("counters") or {}).items():
+            totals[k] = totals.get(k, 0) + int(v)
+    if totals:
+        lines.append(
+            "-- counters (whole span): "
+            + "  ".join(
+                f"{k} {totals[k]}"
+                + (f" ({totals[k] / span:.1f}/s)" if span > 0 else "")
+                for k in sorted(totals)
+            )
+        )
+    rules: dict = {}
+    for r in recs:
+        for name, ent in (r.get("slo") or {}).items():
+            rules.setdefault(name, []).append(ent)
+    if rules:
+        lines.append(f"-- slo burn history ({len(rules)} rules) " + "-" * 24)
+        for name in sorted(rules):
+            ents = rules[name]
+            xs = [e.get("x") for e in ents if e.get("x") is not None]
+            burn = (sum(xs) / len(xs)) if xs else 0.0
+            marks = "".join(
+                "!" if e.get("s") == "breach" else
+                ("x" if e.get("x") else ".")
+                for e in ents[-48:]
+            )
+            lines.append(
+                f" {name:<24} {ents[-1].get('s', '?'):<7}"
+                f"burn {burn:.2f}  [{marks}]"
+            )
+    newest_ex = next(
+        (r["exemplars"] for r in reversed(recs) if r.get("exemplars")),
+        None,
+    )
+    if newest_ex:
+        lines.append("-- exemplars (newest trace id per latency bucket) --")
+        for key in sorted(newest_ex):
+            pairs = list(newest_ex[key].items())[-4:]
+            lines.append(
+                f" {key:<14} "
+                + "  ".join(f"<= {edge}s: {tid}" for edge, tid in pairs)
+            )
+    return "\n".join(lines)
 
 
 def _bar(count: int, peak: int, width: int = 30) -> str:
@@ -367,6 +503,10 @@ def main(argv=None) -> int:
     src.add_argument("--fleet", metavar="URL",
                      help="FleetAggregator rollup URL (obs/fleet.py) — "
                      "renders per-shard/replica/host rows + SLO states")
+    src.add_argument("--timeline", metavar="DIR",
+                     help="flight-data recorder directory "
+                     "(obs/timeline.py) — renders gauge sparklines, "
+                     "SLO burn history and exemplars from disk")
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit")
@@ -381,12 +521,19 @@ def main(argv=None) -> int:
             return snapshot_from_varz(args.varz)
         if args.fleet:
             return snapshot_from_varz(args.fleet)
+        if args.timeline:
+            return snapshot_from_timeline(args.timeline)
         return snapshot_from_jsonl(args.jsonl)
 
     while True:
         try:
             snap = grab()
-            frame = render_fleet(snap) if args.fleet else render(snap)
+            if args.fleet:
+                frame = render_fleet(snap)
+            elif args.timeline:
+                frame = render_timeline(snap)
+            else:
+                frame = render(snap)
         except Exception as e:  # noqa: BLE001 — a scrape gap, keep going
             snap, frame = {}, f"(no data: {type(e).__name__}: {e})"
         if not args.plain and not args.once:
